@@ -1,0 +1,113 @@
+"""Benchmark: CIFAR-10 ResNet-50 training throughput through the Stoke facade.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Measures steady-state images/sec of the full framework path (4-call facade,
+fused compiled micro-step, bf16 precision policy) on whatever accelerator JAX
+exposes (the driver runs this on one real TPU chip).
+
+Baseline: the reference publishes no numbers (BASELINE.md); the north star is
+"CIFAR-10 ResNet-50 per-chip throughput matching an A100 running the
+reference under DDP+AMP".  ``A100_BASELINE_IMGS_PER_SEC`` encodes that
+comparison point as a fixed constant (estimate for ResNet-50 @ 32x32 CIFAR,
+batch 256, AMP, single A100 — CIFAR images are ~50x cheaper than ImageNet's
+224x224, so this is far above ImageNet-scale numbers).  ``vs_baseline`` is
+value / baseline (>1.0 = faster than the A100 estimate).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+A100_BASELINE_IMGS_PER_SEC = 20000.0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", choices=["full", "tiny"], default="full",
+                    help="tiny = CPU-safe smoke (BasicNN, few steps)")
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--warmup", type=int, default=None)
+    args = ap.parse_args()
+
+    import jax
+    import optax
+
+    from stoke_tpu import Stoke, StokeOptimizer
+    from stoke_tpu.models import BasicNN, ResNet50
+
+    tiny = args.preset == "tiny"
+    on_accel = jax.default_backend() not in ("cpu",)
+    batch = args.batch or (16 if tiny else 256)
+    steps = args.steps or (3 if tiny else 30)
+    warmup = args.warmup if args.warmup is not None else (1 if tiny else 5)
+
+    if tiny:
+        model = BasicNN()
+    else:
+        model = ResNet50(num_classes=10, cifar_stem=True)
+    variables = model.init(
+        jax.random.PRNGKey(0), np.zeros((2, 32, 32, 3), np.float32), train=False
+    )
+    stoke = Stoke(
+        model=model,
+        optimizer=StokeOptimizer(
+            optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.05, "momentum": 0.9}
+        ),
+        loss=lambda logits, labels: optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean(),
+        params=variables,
+        batch_size_per_device=batch,
+        device="tpu" if on_accel else "cpu",
+        precision=None if tiny else "bf16",
+        model_train_kwargs={"train": True},
+        model_eval_kwargs={"train": False},
+        verbose=False,
+    )
+
+    r = np.random.default_rng(0)
+    x = r.normal(size=(batch, 32, 32, 3)).astype(np.float32)
+    y = r.integers(0, 10, size=(batch,))
+
+    def one_step():
+        out = stoke.model(x)
+        loss = stoke.loss(out, y)
+        stoke.backward(loss)
+        stoke.step()
+        return loss
+
+    for _ in range(warmup):
+        one_step()
+    stoke.block_until_ready()
+
+    t0 = time.perf_counter()
+    last = None
+    for _ in range(steps):
+        last = one_step()
+    jax.block_until_ready(last)
+    stoke.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch * steps / dt
+    print(
+        json.dumps(
+            {
+                "metric": "cifar10_resnet50_bf16_train_throughput"
+                if not tiny
+                else "cifar10_basicnn_train_throughput",
+                "value": round(imgs_per_sec, 1),
+                "unit": "imgs/sec/chip",
+                "vs_baseline": round(imgs_per_sec / A100_BASELINE_IMGS_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""stoke_tpu: a TPU-native declarative training framework.
+
+Brand-new JAX/XLA/pjit implementation of the capabilities of the reference
+``stoke`` library (facade + status validation + one SPMD engine replacing the
+DDP/Horovod/DeepSpeed/fairscale/AMP backend zoo).  Public API surface mirrors
+the reference ``__all__`` (stoke/__init__.py:17-43) adapted to TPU concepts.
+"""
+
+from stoke_tpu.configs import (
+    ActivationCheckpointingConfig,
+    CheckpointConfig,
+    CheckpointFormat,
+    ClipGradConfig,
+    ClipGradNormConfig,
+    DataParallelConfig,
+    DeviceOptions,
+    DistributedInitConfig,
+    DistributedOptions,
+    FSDPConfig,
+    LossReduction,
+    MeshConfig,
+    OSSConfig,
+    ParamNormalize,
+    PrecisionConfig,
+    PrecisionOptions,
+    ProfilerConfig,
+    SDDPConfig,
+    ShardingOptions,
+    StokeOptimizer,
+)
+from stoke_tpu.data import BucketedDistributedSampler, StokeDataLoader
+from stoke_tpu.engine import (
+    DeferredOutput,
+    FlaxModelAdapter,
+    FunctionalModelAdapter,
+    ModelAdapter,
+)
+from stoke_tpu.facade import Stoke
+from stoke_tpu.status import StokeStatus, StokeValidationError
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Stoke",
+    "StokeStatus",
+    "StokeValidationError",
+    "StokeOptimizer",
+    "StokeDataLoader",
+    "BucketedDistributedSampler",
+    # enums
+    "DeviceOptions",
+    "DistributedOptions",
+    "PrecisionOptions",
+    "ShardingOptions",
+    "ParamNormalize",
+    "LossReduction",
+    "CheckpointFormat",
+    # configs
+    "PrecisionConfig",
+    "ClipGradConfig",
+    "ClipGradNormConfig",
+    "DataParallelConfig",
+    "MeshConfig",
+    "DistributedInitConfig",
+    "OSSConfig",
+    "SDDPConfig",
+    "FSDPConfig",
+    "ActivationCheckpointingConfig",
+    "CheckpointConfig",
+    "ProfilerConfig",
+    # adapters
+    "ModelAdapter",
+    "FlaxModelAdapter",
+    "FunctionalModelAdapter",
+    "DeferredOutput",
+]

@@ -1,0 +1,448 @@
+"""Configuration layer: typed config dataclasses + option enums.
+
+TPU-native re-design of the reference config system (stoke/configs.py:1-770).
+The reference surfaces every tunable of its five GPU backends (DDP, Horovod,
+DeepSpeed, fairscale, Apex/AMP) as 16 attrs classes.  On TPU those backends
+collapse into one SPMD engine (mesh + named shardings + XLA collectives), so
+the config surface regroups by *concern* rather than by backend:
+
+- runtime selection enums  (reference: stoke/status.py:31-45)
+- precision policy         (reference: AMPConfig configs.py:44, ApexConfig :68,
+                            DeepspeedFP16Config :283)
+- gradient clipping        (reference: ClipGradConfig :100, ClipGradNormConfig :113)
+- data parallelism / mesh  (reference: DDPConfig :131, HorovodConfig :726)
+- sharding tiers           (reference: FairscaleOSSConfig :577,
+                            FairscaleSDDPConfig :597, FairscaleFSDPConfig :634,
+                            DeepspeedZeROConfig :409)
+- multi-host rendezvous    (reference: BackendOptions configs.py:36-41 +
+                            env:///MPI discovery, distributed.py:491-525)
+- activation checkpointing (reference: DeepspeedActivationCheckpointingConfig :222)
+- checkpoint IO            (reference: io_ops.py save/load knobs)
+- profiling                (reference: DeepspeedFlopsConfig :252,
+                            wall_clock_breakdown :540)
+
+Everything here is pure data (stdlib dataclasses) with validation deferred to
+`stoke_tpu.status.StokeStatus`, mirroring the reference's split between the
+config layer (L1) and the status/validation layer (L3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, TypedDict
+
+
+# --------------------------------------------------------------------------- #
+# Option enums (reference: stoke/status.py:31-45, stoke/configs.py:20-41)
+# --------------------------------------------------------------------------- #
+
+
+class DeviceOptions(Enum):
+    """Compute device selector (reference `gpu: bool` flag, stoke/stoke.py:141).
+
+    The reference toggles CPU vs CUDA; here the accelerator is TPU.  ``cpu``
+    maps to the JAX CPU backend (also used for simulated-device testing via
+    ``--xla_force_host_platform_device_count``).
+    """
+
+    cpu = "cpu"
+    tpu = "tpu"
+
+
+class DistributedOptions(Enum):
+    """Distributed strategy selector (reference: status.py:31-38 with
+    {ddp, deepspeed, horovod}).
+
+    On TPU the three process-wrapper backends collapse into a single SPMD
+    engine driven by a device mesh; ``dp`` is data parallelism over the mesh
+    ``data`` axis with XLA-compiled collectives over ICI/DCN (SURVEY.md §2.9).
+    """
+
+    dp = "dp"
+
+
+class PrecisionOptions(Enum):
+    """Mixed-precision selector (reference FP16Options: status.py:40-45 with
+    {apex_O1, apex_O2, amp, deepspeed}).
+
+    - ``full``: fp32 params + fp32 compute (reference "full" passthrough).
+    - ``bf16``: fp32 params, bfloat16 compute.  TPU-native mixed precision:
+      bf16 has an fp32-range exponent so no loss scaler is required
+      (replaces the entire GradScaler machinery, reference fp16.py:694-806).
+    - ``fp16``: fp32 params, float16 compute with a functional dynamic loss
+      scaler for exact-parity experiments (reference native AMP semantics,
+      fp16.py:731-748).
+    """
+
+    full = "full"
+    bf16 = "bf16"
+    fp16 = "fp16"
+
+
+class ShardingOptions(Enum):
+    """Sharding-tier ladder (the ZeRO-1/2/3 ladder; reference extensions.py).
+
+    Not user-facing as an enum in the reference (three booleans:
+    ``fairscale_oss``, ``fairscale_sddp``, ``fairscale_fsdp``); surfaced here
+    for table-driven validation.
+    """
+
+    none = "none"
+    oss = "oss"  # optimizer-state sharding (ZeRO-1; reference extensions.py:81-141)
+    sddp = "sddp"  # + gradient sharding (ZeRO-2; reference extensions.py:219-286)
+    fsdp = "fsdp"  # + parameter sharding (ZeRO-3; reference extensions.py:289-376)
+
+
+class ParamNormalize(Enum):
+    """Divisors for pretty-printing parameter counts
+    (reference: stoke/utils.py:30-36)."""
+
+    BILLION = 1e9
+    GIGA = 2**30
+    KILO = 2**10
+    MEGA = 2**20
+    MILLION = 1e6
+    THOUSAND = 1e3
+
+
+class LossReduction(Enum):
+    """Cross-replica loss reduction (reference Horovod ops Average/Sum/Adasum,
+    configs.py:20-25; DDP divides summed loss by world size,
+    distributed.py:619-646)."""
+
+    mean = "mean"
+    sum = "sum"
+
+
+class CheckpointFormat(Enum):
+    """Checkpoint layouts (reference: consolidated rank-0 torch.save in
+    DDPIO/HorovodIO io_ops.py:551-703 vs sharded DeepSpeed engine checkpoints
+    io_ops.py:389-544)."""
+
+    consolidated = "consolidated"
+    sharded = "sharded"
+
+
+# --------------------------------------------------------------------------- #
+# Precision
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class PrecisionConfig:
+    """Precision policy + functional loss-scaler tunables.
+
+    Replaces reference AMPConfig (configs.py:44-65: init_scale, growth_factor,
+    backoff_factor, growth_interval, enabled) and the Apex/DeepSpeed scaler
+    configs (configs.py:68-97, :283-306).  The scaler fields only apply when
+    ``precision == fp16``; bf16 needs none (fp32-range exponent).
+
+    Attributes:
+        param_dtype: dtype of the master copy of parameters (always fp32 by
+            default, matching AMP master-weight semantics).
+        output_dtype: dtype model outputs are cast to after compute (fp32 to
+            keep user-side loss math stable).
+        init_scale: initial loss scale (reference AMPConfig.init_scale 2**16).
+        growth_factor: scale multiplier after ``growth_interval`` consecutive
+            finite steps (reference AMPConfig.growth_factor 2.0).
+        backoff_factor: scale multiplier on overflow (reference 0.5).
+        growth_interval: finite-step window before growth (reference 2000).
+        min_scale: floor for the dynamic scale.
+    """
+
+    param_dtype: str = "float32"
+    output_dtype: str = "float32"
+    init_scale: float = 2.0**16
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 2000
+    min_scale: float = 1.0
+
+
+# --------------------------------------------------------------------------- #
+# Gradient clipping (reference: configs.py:100-128)
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class ClipGradConfig:
+    """Clip gradients element-wise by value (reference configs.py:100-110)."""
+
+    clip_value: float = 1.0
+
+
+@dataclass
+class ClipGradNormConfig:
+    """Clip gradients by global norm (reference configs.py:113-128).
+
+    On TPU the global norm is computed on logically-global (sharded) gradient
+    arrays inside the compiled step, so the special per-backend synced-norm
+    implementations of the reference (fp16.py:222-235 OSS/FSDP variants)
+    collapse into one code path.
+    """
+
+    max_norm: float = 1.0
+    norm_type: float = 2.0
+
+
+# --------------------------------------------------------------------------- #
+# Data parallel / mesh / rendezvous
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class DataParallelConfig:
+    """SPMD data-parallel engine knobs.
+
+    Replaces reference DDPConfig (configs.py:131-189) and HorovodConfig
+    (configs.py:726-751).  Buckets, `find_unused_parameters`,
+    `gradient_as_bucket_view`, compression etc. have no TPU equivalent: XLA
+    owns collective scheduling/fusion.  What survives:
+
+    Attributes:
+        axis_name: mesh axis gradients/batch are sharded over.
+        sync_batch_stats: cross-replica BatchNorm statistics (reference
+            SyncBatchNorm conversion, distributed.py:575-579, :1318-1371).
+            With jit-GSPMD over a global batch this is automatic — stats are
+            computed over the logically-global batch; the flag is kept so the
+            eval/io paths know batch stats are already synchronized.
+        loss_reduction: how per-device losses combine (reference
+            distributed.py:619-646 sum/world_size; HorovodOps configs.py:20-25).
+        convert_to_sync_batchnorm: kept for API parity with reference
+            DDPConfig.convert_to_sync_batch_norm (configs.py:176).
+    """
+
+    axis_name: str = "data"
+    sync_batch_stats: bool = True
+    loss_reduction: LossReduction = LossReduction.mean
+    convert_to_sync_batchnorm: bool = False
+
+
+@dataclass
+class MeshConfig:
+    """Logical device mesh specification.
+
+    The reference has no mesh concept (process-per-GPU); this is the TPU-native
+    replacement for its backend/process-group configuration (SURVEY.md §2.9).
+    Axes beyond ``data`` (e.g. ``model``, ``seq``, ``expert``) are first-class
+    so later tiers (tensor/sequence/expert parallel) are mesh re-labelings, not
+    rewrites.
+
+    Attributes:
+        axes: ordered mesh axis names.
+        shape: devices per axis; -1 infers from device count (like numpy
+            reshape).  ``None`` → 1-D mesh over all devices on ``axes[0]``.
+        devices: explicit device list override (tests / subsets).
+        dcn_axes: axis names that cross slice boundaries (mapped onto DCN
+            rather than ICI when running multi-slice).
+    """
+
+    axes: Tuple[str, ...] = ("data",)
+    shape: Optional[Tuple[int, ...]] = None
+    devices: Optional[Any] = None
+    dcn_axes: Tuple[str, ...] = ()
+
+
+@dataclass
+class DistributedInitConfig:
+    """Multi-host rendezvous via ``jax.distributed.initialize``.
+
+    Replaces the reference's launcher-provided env rendezvous
+    (RANK/WORLD_SIZE/MASTER_ADDR, configs.py:186 ``init_method="env://"``) and
+    MPI discovery (distributed.py:491-525).  All fields ``None`` → JAX infers
+    from the environment (TPU metadata / coordinator env vars), which is the
+    common TPU path.
+    """
+
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+    local_device_ids: Optional[Sequence[int]] = None
+    initialization_timeout: int = 300
+    auto_initialize: bool = True
+
+
+# --------------------------------------------------------------------------- #
+# Sharding tiers (the ZeRO ladder)
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class OSSConfig:
+    """Optimizer-state sharding (ZeRO-1 equivalent).
+
+    Reference: FairscaleOSSConfig (configs.py:577-594) wrapping fairscale OSS
+    (extensions.py:81-141).  TPU-native: optimizer-state leaves get a
+    NamedSharding over the data axis (weight-update sharding,
+    arxiv 2004.13336); XLA inserts the all-gathers/reduce-scatters.
+
+    Attributes:
+        min_shard_size: leaves with fewer elements stay replicated (sharding
+            tiny tensors costs more in collective latency than it saves).
+    """
+
+    min_shard_size: int = 2**10
+
+
+@dataclass
+class SDDPConfig:
+    """Gradient + optimizer-state sharding (ZeRO-2 equivalent).
+
+    Reference: FairscaleSDDPConfig (configs.py:597-631) wrapping
+    ShardedDataParallel (extensions.py:219-286).  TPU-native: the gradient
+    accumulation buffer is sharded like the optimizer state, so XLA lowers the
+    gradient combine to reduce-scatter instead of all-reduce.
+
+    ``reduce_buffer_size``/``auto_refresh_trainable`` from the reference have
+    no XLA equivalent (compiler-managed).
+    """
+
+    min_shard_size: int = 2**10
+    broadcast_buffers: bool = True  # parity field (configs.py:612); no-op in SPMD
+
+
+@dataclass
+class FSDPConfig:
+    """Fully-sharded parameters (ZeRO-3 / FSDP equivalent).
+
+    Reference: FairscaleFSDPConfig (configs.py:634-723) wrapping
+    FullyShardedDataParallel (extensions.py:289-376).  TPU-native: parameter
+    leaves get NamedShardings over the data axis; XLA schedules the
+    all-gather-before-use / reduce-scatter-after-grad that FSDP hand-implements
+    (``reshard_after_forward`` ≈ XLA rematerializing gathers, controlled here
+    by pairing with activation checkpointing).
+
+    Attributes:
+        min_weight_size: parameters with fewer elements stay replicated
+            (reference FSDP ``min_num_params`` style bucketing).
+        shard_axis_preference: "largest" shards the largest divisible dim;
+            "first" shards dim 0 when divisible.
+        reshard_after_forward: parity flag (configs.py:660); on TPU XLA decides
+            when to discard gathered params, so this only toggles a remat hint.
+    """
+
+    min_weight_size: int = 2**10
+    shard_axis_preference: str = "largest"
+    reshard_after_forward: bool = True
+
+
+# --------------------------------------------------------------------------- #
+# Activation checkpointing (reference: configs.py:222-248)
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class ActivationCheckpointingConfig:
+    """Rematerialization policy mapped onto ``jax.checkpoint``.
+
+    Reference: DeepspeedActivationCheckpointingConfig (configs.py:222-248),
+    config-passthrough only (distributed.py:965-983).  TPU-native this is a
+    first-class transform: ``policy`` selects a ``jax.checkpoint_policies``
+    member applied to the model step.
+
+    Attributes:
+        policy: one of {"nothing_saveable", "dots_saveable",
+            "dots_with_no_batch_dims_saveable", "everything_saveable"}.
+        prevent_cse: forwarded to ``jax.checkpoint``.
+    """
+
+    policy: str = "nothing_saveable"
+    prevent_cse: bool = True
+
+
+# --------------------------------------------------------------------------- #
+# Checkpoint IO (reference: io_ops.py)
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class CheckpointConfig:
+    """Unified checkpoint behavior.
+
+    Reference splits IO across four mixins (BaseStokeIO/DDPIO/HorovodIO/
+    DeepspeedIO, io_ops.py:20-746); here one checkpointer with a format switch:
+    ``consolidated`` gathers to host and writes one file (reference rank-0
+    torch.save, io_ops.py:551-623), ``sharded`` writes per-host shards with a
+    metadata blob via orbax/tensorstore (reference DeepSpeed engine sharded
+    save, io_ops.py:389-483).
+    """
+
+    format: CheckpointFormat = CheckpointFormat.consolidated
+    max_to_keep: Optional[int] = None
+    async_save: bool = False
+
+
+# --------------------------------------------------------------------------- #
+# Profiling / observability (reference: configs.py:252-279, :540)
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class ProfilerConfig:
+    """First-class profiling (SURVEY.md §5: native win over the reference's
+    DeepSpeed flops-profiler passthrough, configs.py:252-279).
+
+    Attributes:
+        trace_dir: where ``jax.profiler`` traces are written (serves the
+            TensorBoard profile plugin / xprof).
+        flops_estimate: log an XLA cost-analysis FLOPs estimate of the compiled
+            train step (replaces DeepspeedFlopsConfig).
+        wall_clock_breakdown: per-phase host timing of the facade calls
+            (reference configs.py:540).
+    """
+
+    trace_dir: Optional[str] = None
+    flops_estimate: bool = False
+    wall_clock_breakdown: bool = False
+
+
+# --------------------------------------------------------------------------- #
+# Optimizer TypedDict (reference: configs.py:754-770)
+# --------------------------------------------------------------------------- #
+
+
+class StokeOptimizer(TypedDict):
+    """Uninstantiated optimizer + kwargs (reference configs.py:754-770).
+
+    ``optimizer`` is an optax transformation *constructor* (e.g. ``optax.sgd``,
+    ``optax.adamw``); ``optimizer_kwargs`` its keyword args.  Mirrors the
+    reference contract of passing ``torch.optim.SGD`` + kwargs so the facade
+    owns instantiation (after sharding decisions are made).
+    """
+
+    optimizer: Callable[..., Any]
+    optimizer_kwargs: Dict[str, Any]
+
+
+# All config classes recognized by the status layer, keyed by class name
+# (reference dedupe-by-class-name logic, status.py:321-343).
+ALL_CONFIG_CLASSES: Tuple[type, ...] = (
+    PrecisionConfig,
+    ClipGradConfig,
+    ClipGradNormConfig,
+    DataParallelConfig,
+    MeshConfig,
+    DistributedInitConfig,
+    OSSConfig,
+    SDDPConfig,
+    FSDPConfig,
+    ActivationCheckpointingConfig,
+    CheckpointConfig,
+    ProfilerConfig,
+)
+
+
+def asdict_config(cfg: Any) -> Dict[str, Any]:
+    """Dataclass → plain dict with enums rendered to their values (used for
+    status reporting + checkpoint metadata, reference status.py:629-654)."""
+    if cfg is None:
+        return {}
+    out = {}
+    for f in dataclasses.fields(cfg):
+        v = getattr(cfg, f.name)
+        if isinstance(v, Enum):
+            v = v.value
+        out[f.name] = v
+    return out

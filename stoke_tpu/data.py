@@ -1,0 +1,395 @@
+"""Data layer: device-placing DataLoader + BucketedDistributedSampler.
+
+TPU-native re-design of the reference data side-car (stoke/data.py:24-516):
+
+- :class:`StokeDataLoader` (reference data.py:24-108): wraps a host-side
+  loader (torch's, when available — it is the best multi-worker host loader
+  and carries zero CUDA dependency on CPU) and yields batches already placed
+  in device HBM, *sharded over the mesh data axis*, with one-batch lookahead
+  so the host→HBM transfer of batch N+1 overlaps the compute of batch N
+  (SURVEY.md §3.3: host loader + double-buffered ``device_put`` replaces
+  per-rank ``.cuda()`` pushes).
+
+- :class:`BucketedDistributedSampler` (reference data.py:111-516): buckets a
+  pre-sorted index list (e.g. by sequence length) so each batch draws
+  similar-length samples, minimizing padding waste.  Re-implemented from the
+  reference's documented semantics with the same invariants (per-epoch seeded
+  in-bucket shuffle, stride-aligned padding of the short final slice,
+  round-robin replica slicing, optional residual "overlap" batches, identical
+  ``__len__``).  In this framework a "replica" is a *loading process* (host),
+  not a device: one process feeds a contiguous slice of the logically-global
+  batch to all its local devices.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from typing import Any, Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+# --------------------------------------------------------------------------- #
+# Loader
+# --------------------------------------------------------------------------- #
+
+
+def _default_collate(samples: List[Any]):
+    """Minimal numpy collate for the torch-free fallback path: stacks arrays
+    (and array-likes) leaf-wise over tuples/lists/dicts."""
+    first = samples[0]
+    if isinstance(first, (tuple, list)):
+        return type(first)(_default_collate(list(s)) for s in zip(*samples))
+    if isinstance(first, dict):
+        return {k: _default_collate([s[k] for s in samples]) for k in first}
+    return np.stack([np.asarray(s) for s in samples])
+
+
+class _FallbackLoader:
+    """Dependency-free map-style loader (no workers) used when torch is not
+    importable.  Supports batch_size/shuffle/sampler/drop_last/collate_fn."""
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int = 1,
+        shuffle: bool = False,
+        sampler: Optional[Sequence[int]] = None,
+        drop_last: bool = False,
+        collate_fn: Optional[Callable] = None,
+        seed: int = 0,
+        **_unused,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.sampler = sampler
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or _default_collate
+        self._epoch_seed = seed
+
+    def __len__(self):
+        n = len(self.sampler) if self.sampler is not None else len(self.dataset)
+        return n // self.batch_size if self.drop_last else math.ceil(n / self.batch_size)
+
+    def __iter__(self):
+        if self.sampler is not None:
+            order = list(iter(self.sampler))
+        else:
+            order = list(range(len(self.dataset)))
+            if self.shuffle:
+                rng = np.random.default_rng(self._epoch_seed)
+                self._epoch_seed += 1
+                rng.shuffle(order)
+        for start in range(0, len(order), self.batch_size):
+            idx = order[start : start + self.batch_size]
+            if self.drop_last and len(idx) < self.batch_size:
+                break
+            yield self.collate_fn([self.dataset[i] for i in idx])
+
+
+class StokeDataLoader:
+    """Loader facade yielding device-resident, mesh-sharded batches.
+
+    Built via ``Stoke.DataLoader`` (reference stoke.py:737-851), which injects
+    ``batch_size`` (per-process) and ``place_fn`` (host batch → sharded device
+    arrays) from the validated status — preserving the reference paradigm that
+    "the flags only need to be set and never handled" (data.py:44-47).
+
+    Accepts the torch DataLoader surface (num_workers, pin_memory is ignored,
+    sampler, collate_fn, ...) and falls back to a dependency-free loader when
+    torch is absent.
+
+    Args:
+        prefetch: number of batches to keep in flight on device (default 2 =
+            double buffering).  Transfers are async dispatches; lookahead
+            overlaps host→HBM copy with device compute.
+        place: set False to get host batches (escape hatch).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        place_fn: Optional[Callable] = None,
+        prefetch: int = 2,
+        place: bool = True,
+        **kwargs,
+    ):
+        self._place_fn = place_fn if place else None
+        self._prefetch = max(int(prefetch), 1)
+        self.batch_size = batch_size
+        try:
+            from torch.utils import data as torch_data
+
+            if "collate_fn" not in kwargs:
+                kwargs["collate_fn"] = _numpy_safe_torch_collate()
+            self._loader = torch_data.DataLoader(
+                dataset, batch_size=batch_size, **kwargs
+            )
+        except ImportError:
+            self._loader = _FallbackLoader(dataset, batch_size=batch_size, **kwargs)
+
+    def __len__(self):
+        return len(self._loader)
+
+    @property
+    def sampler(self):
+        return getattr(self._loader, "sampler", None)
+
+    def set_epoch(self, epoch: int) -> None:
+        """Forward to a distributed sampler when present (reference users call
+        ``loader.sampler.set_epoch`` directly; this is a convenience)."""
+        s = self.sampler
+        if s is not None and hasattr(s, "set_epoch"):
+            s.set_epoch(epoch)
+
+    def __iter__(self):
+        if self._place_fn is None:
+            yield from self._loader
+            return
+        # lookahead pipeline: keep `prefetch` placed batches in flight
+        queue: List[Any] = []
+        it = iter(self._loader)
+        try:
+            for _ in range(self._prefetch):
+                queue.append(self._place_fn(next(it)))
+        except StopIteration:
+            pass
+        while queue:
+            out = queue.pop(0)
+            try:
+                queue.append(self._place_fn(next(it)))
+            except StopIteration:
+                pass
+            yield out
+
+
+def _numpy_safe_torch_collate():
+    """torch's default collate, post-converted to numpy so downstream device
+    placement never touches torch dtypes XLA can't ingest (bf16 etc. stay on
+    the JAX side of the fence)."""
+    from torch.utils.data._utils.collate import default_collate
+
+    def _collate(samples):
+        batch = default_collate(samples)
+
+        def _to_np(x):
+            if hasattr(x, "detach"):
+                return x.detach().cpu().numpy()
+            return x
+
+        if isinstance(batch, (tuple, list)):
+            return type(batch)(_to_np(b) for b in batch)
+        if isinstance(batch, dict):
+            return {k: _to_np(v) for k, v in batch.items()}
+        return _to_np(batch)
+
+    return _collate
+
+
+# --------------------------------------------------------------------------- #
+# Bucketed distributed sampler (reference data.py:111-516)
+# --------------------------------------------------------------------------- #
+
+
+class BucketedDistributedSampler:
+    """Distributed sampler drawing each batch from one similar-length bucket.
+
+    Semantics mirror the reference (stoke/data.py:111-516): the caller
+    provides ``sorted_idx`` — dataset indices pre-sorted by the bucketing
+    characteristic (e.g. sequence length).  The index list is split into
+    ``buckets`` contiguous buckets; every epoch each bucket is shuffled
+    internally (seeded by ``seed + epoch``), carved into *slices* of
+    ``batch_size × num_replicas``, and each replica takes a strided
+    (``rank::num_replicas``) sub-batch of every slice, so all replicas see
+    equal-size, similar-length batches.  Short final slices are padded by
+    borrowing stride-aligned indices from the bucket head (reference
+    data.py:450-498); with ``drop_last + allow_bucket_overlap`` the dropped
+    residuals are regrouped into extra (mixed-length) batches
+    (reference data.py:419-434).  Batch order is then shuffled across buckets
+    so consecutive batches don't walk monotonically through lengths.
+
+    Invariants (property-tested in tests/test_data.py, mirroring the asserts
+    at reference data.py:409 and :447):
+      * every yielded epoch has exactly ``len(self)`` indices;
+      * each padded bucket expands to exactly
+        ``num_slices_per_bucket × slice_size`` indices;
+      * the union of all replicas' indices per slice is the slice itself.
+
+    Args:
+        dataset: sized dataset (only ``len`` is used).
+        buckets: number of contiguous buckets.
+        batch_size: per-replica batch size (for this framework: the
+            *per-process* batch — batch_size_per_device × local mesh share).
+        sorted_idx: dataset indices sorted by the bucketing key.
+        num_replicas: loading processes (default ``jax.process_count()``).
+        rank: this process (default ``jax.process_index()``).
+        allow_bucket_overlap / shuffle / seed / drop_last / info_rank: as in
+            the reference.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        buckets: int,
+        batch_size: int,
+        sorted_idx: Sequence[int],
+        allow_bucket_overlap: bool = False,
+        num_replicas: Optional[int] = None,
+        rank: Optional[int] = None,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+        info_rank: int = 0,
+        backend: Any = None,  # parity arg; topology comes from JAX, not enums
+    ):
+        if num_replicas is None or rank is None:
+            import jax
+
+            num_replicas = num_replicas if num_replicas is not None else jax.process_count()
+            rank = rank if rank is not None else jax.process_index()
+        if not (0 <= rank < num_replicas):
+            raise ValueError(
+                f"Stoke -- sampler rank {rank} out of range for {num_replicas} replicas"
+            )
+        self.num_replicas = int(num_replicas)
+        self.rank = int(rank)
+        self.epoch = 0
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        self.seed = seed
+        self.buckets = int(buckets)
+        self.batch_size = int(batch_size)
+        self.sorted_idx = list(sorted_idx)
+        self.allow_bucket_overlap = allow_bucket_overlap
+
+        self.slice_size = self.batch_size * self.num_replicas
+        n = len(dataset)
+        self.num_samples_per_bucket = self._split_size(n, self.buckets, drop_last)
+        self.num_slices_per_bucket = self._split_size(
+            self.num_samples_per_bucket, self.slice_size, drop_last
+        )
+        # sanity gates mirroring reference data.py:228-243
+        if self.num_samples_per_bucket < self.slice_size:
+            raise ValueError(
+                f"Stoke -- samples per bucket ({self.num_samples_per_bucket}) is "
+                f"smaller than one slice (batch × replicas = {self.slice_size})"
+            )
+        if self.num_slices_per_bucket < 2:
+            raise ValueError(
+                f"Stoke -- only {self.num_slices_per_bucket} slice(s) per bucket; "
+                f"need >= 2 (use fewer buckets or a smaller batch)"
+            )
+        if self.num_samples_per_bucket < 100:
+            raise ValueError(
+                f"Stoke -- {self.num_samples_per_bucket} samples per bucket < 100 "
+                f"would drop excessive data (use fewer buckets)"
+            )
+        self.bucket_idx = [
+            list(chunk) for chunk in np.array_split(np.asarray(self.sorted_idx), self.buckets)
+        ]
+        self.rounded_num_samples_per_bucket = (
+            self.num_slices_per_bucket * self.slice_size
+        )
+        self.rounded_num_samples_per_replica = (
+            self.num_slices_per_bucket * self.batch_size * self.buckets
+        )
+        if self.allow_bucket_overlap:
+            residual = n - self.rounded_num_samples_per_bucket * self.buckets
+            self.rounded_num_samples_per_replica += (
+                residual // self.slice_size
+            ) * self.batch_size
+        if self.rank == info_rank:
+            print(
+                f"Stoke -- BucketedDistributedSampler -- samples/bucket: "
+                f"{self.rounded_num_samples_per_bucket}, samples/replica: "
+                f"{self.rounded_num_samples_per_replica}"
+            )
+
+    @staticmethod
+    def _split_size(total: int, parts: int, drop_last: bool) -> int:
+        return total // parts if drop_last else math.ceil(total / parts)
+
+    # ------------------------------------------------------------------ #
+
+    def _pad_bucket(self, bucket: List[int]) -> List[int]:
+        """Extend a short bucket to exactly ``num_slices × slice_size``
+        entries so the strided replica slicing stays aligned (reference
+        ``_handle_padding``, data.py:450-498).
+
+        The final (short) slice is padded by borrowing indices from the
+        bucket head with stride ``num_replicas``, interleaved so that each
+        replica's strided sub-batch reaches exactly ``batch_size``.
+        """
+        full = (self.num_slices_per_bucket - 1) * self.slice_size
+        head, short = bucket[:full], bucket[full:]
+        # how many each replica is short: replica r owns positions
+        # r, r+num_replicas, ... of the slice
+        per_replica = [
+            len(short[r :: self.num_replicas]) for r in range(self.num_replicas)
+        ]
+        need = [self.batch_size - c for c in per_replica]
+        # borrow stride-aligned values from the bucket head for each replica
+        donors = [
+            bucket[r : self.num_replicas * need[r] : self.num_replicas]
+            for r in range(self.num_replicas)
+        ]
+        # if replicas need unequal amounts, rotate so the longest-need replica
+        # leads and the interleave stays stride-consistent
+        if len(set(need)) > 1:
+            lead = need.index(max(need))
+            donors = donors[lead:] + donors[:lead]
+        pad = [
+            v
+            for v in itertools.chain(*itertools.zip_longest(*donors))
+            if v is not None
+        ]
+        return head + short + pad
+
+    def _epoch_rng(self) -> np.random.Generator:
+        return np.random.default_rng(self.seed + self.epoch)
+
+    def __iter__(self) -> Iterator[int]:
+        rng = self._epoch_rng()
+        if self.shuffle:
+            buckets = [list(np.asarray(b)[rng.permutation(len(b))]) for b in self.bucket_idx]
+        else:
+            buckets = [list(b) for b in self.bucket_idx]
+        # pad any bucket that cannot fill its slices
+        for i, b in enumerate(buckets):
+            if len(b) < self.rounded_num_samples_per_bucket:
+                padded = self._pad_bucket(b)
+                assert len(padded) == self.rounded_num_samples_per_bucket
+                buckets[i] = padded
+        # carve into slices; each replica takes its strided sub-batch
+        batches: List[List[int]] = []
+        for b in buckets:
+            for s in range(self.num_slices_per_bucket):
+                sl = b[s * self.slice_size : (s + 1) * self.slice_size]
+                batches.append(sl[self.rank : self.slice_size : self.num_replicas])
+        # regroup dropped residuals into extra mixed batches
+        if self.drop_last and self.allow_bucket_overlap:
+            residual = list(
+                itertools.chain(
+                    *[b[self.rounded_num_samples_per_bucket :] for b in buckets]
+                )
+            )
+            for s in range(len(residual) // self.slice_size):
+                sl = residual[s * self.slice_size : (s + 1) * self.slice_size]
+                batches.append(sl[self.rank : self.slice_size : self.num_replicas])
+        if self.shuffle:
+            order = rng.permutation(len(batches))
+            batches = [batches[i] for i in order]
+        flat = [int(i) for i in itertools.chain(*batches)]
+        assert len(flat) == self.rounded_num_samples_per_replica
+        return iter(flat)
+
+    def __len__(self) -> int:
+        return self.rounded_num_samples_per_replica
+
+    def set_epoch(self, epoch: int) -> None:
+        """Per-epoch reseed so replicas reshuffle consistently (reference
+        data.py:503-516)."""
+        self.epoch = epoch

@@ -1,0 +1,657 @@
+"""Functional core: compiled train/eval steps behind the imperative facade.
+
+This module solves SURVEY.md §7 hard part #1 — keeping the reference's
+imperative 4-call contract (``model → loss → backward → step``,
+stoke/stoke.py:853-1040) over a purely functional JAX core — with a *lazy
+fused step*:
+
+- ``model(x)`` (train mode) returns a :class:`DeferredOutput` handle and
+  stashes the batch; nothing runs.
+- ``loss(out, y)`` runs ONE compiled function that does forward + loss +
+  grad + accumulate-into-buffer (micro-step), returning device-scalar losses.
+  This is the TPU answer to the reference's per-micro-batch synchronous
+  ``.item()`` + allreduce (distributed.py:619-646): the loss stays on device,
+  the gradient all-reduce/reduce-scatter is compiler-inserted, and there is
+  exactly one dispatch per micro-batch.
+- ``backward(loss)`` commits the accumulated buffer (pointer swap — the
+  accumulation already happened inside the compiled step; un-committed
+  buffers are simply dropped, preserving "no backward → no grads").
+- ``step()`` runs the compiled apply: unscale → clip → optimizer update →
+  zero the buffer, under the sharding rules of the active tier.
+
+Precision policy (SURVEY.md §3.2 observation (c)): params live in fp32
+(master weights), compute runs in the policy dtype (bf16 natively on TPU; no
+loss scaler needed — fp32-range exponent).  fp16 gets a *functional* dynamic
+loss scaler (scale/growth_count carried as device state) replacing
+``torch.cuda.amp.GradScaler`` (reference fp16.py:694-806).
+
+Gradient accumulation lives inside the compiled step as a buffer add
+(reference: Python-side counters + DDP ``no_sync``, stoke.py:326-344,
+distributed.py:648-669 — no ``no_sync`` needed here: nothing eagerly syncs).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from stoke_tpu.configs import (
+    ActivationCheckpointingConfig,
+    ClipGradConfig,
+    ClipGradNormConfig,
+    PrecisionConfig,
+    PrecisionOptions,
+    StokeOptimizer,
+)
+from stoke_tpu.parallel.sharding import ShardingRules
+from stoke_tpu.utils.trees import tree_cast, tree_finite, tree_zeros_like
+
+
+# --------------------------------------------------------------------------- #
+# Model adapters
+# --------------------------------------------------------------------------- #
+
+
+class ModelAdapter:
+    """Contract between the facade and any model flavor.
+
+    ``variables`` is a dict of collections with a ``"params"`` entry (flax
+    convention); gradients are taken w.r.t. ``variables["params"]`` only.
+    ``apply_train`` may update non-param collections (e.g. BatchNorm
+    ``batch_stats`` — the reference needs SyncBatchNorm conversion for this,
+    distributed.py:575-579; under jit-GSPMD the batch moments are computed
+    over the logically-global batch, so cross-replica sync is automatic).
+    """
+
+    def apply_train(
+        self, variables: Dict[str, Any], rng, args: tuple, kwargs: dict
+    ) -> Tuple[Any, Dict[str, Any]]:
+        raise NotImplementedError
+
+    def apply_eval(self, variables: Dict[str, Any], args: tuple, kwargs: dict) -> Any:
+        raise NotImplementedError
+
+
+class FlaxModelAdapter(ModelAdapter):
+    """Adapter for ``flax.linen.Module`` models.
+
+    Args:
+        module: the linen module.
+        train_kwargs / eval_kwargs: extra kwargs distinguishing train/eval
+            application (e.g. ``{"train": True}`` / ``{"train": False}`` for
+            modules with dropout/BN) — replaces torch's implicit
+            ``module.train()/eval()`` mode bit the reference relies on.
+        rng_keys: names of rng streams to thread (default ``("dropout",)``).
+    """
+
+    def __init__(
+        self,
+        module,
+        train_kwargs: Optional[dict] = None,
+        eval_kwargs: Optional[dict] = None,
+        rng_keys: Sequence[str] = ("dropout",),
+    ):
+        self.module = module
+        self.train_kwargs = dict(train_kwargs or {})
+        self.eval_kwargs = dict(eval_kwargs or {})
+        self.rng_keys = tuple(rng_keys)
+
+    def apply_train(self, variables, rng, args, kwargs):
+        mutable = [k for k in variables.keys() if k != "params"]
+        rngs = None
+        if self.rng_keys:
+            keys = jax.random.split(rng, len(self.rng_keys))
+            rngs = {name: keys[i] for i, name in enumerate(self.rng_keys)}
+        merged = {**kwargs, **self.train_kwargs}
+        if mutable:
+            out, updated = self.module.apply(
+                variables, *args, rngs=rngs, mutable=mutable, **merged
+            )
+            return out, dict(updated)
+        out = self.module.apply(variables, *args, rngs=rngs, **merged)
+        return out, {}
+
+    def apply_eval(self, variables, args, kwargs):
+        merged = {**kwargs, **self.eval_kwargs}
+        return self.module.apply(variables, *args, **merged)
+
+
+class FunctionalModelAdapter(ModelAdapter):
+    """Adapter for a plain callable ``fn(params, *args, **kwargs) -> out``
+    (no rng, no mutable collections, identical train/eval behavior)."""
+
+    def __init__(self, fn: Callable, eval_fn: Optional[Callable] = None):
+        self.fn = fn
+        self.eval_fn = eval_fn or fn
+
+    def apply_train(self, variables, rng, args, kwargs):
+        return self.fn(variables["params"], *args, **kwargs), {}
+
+    def apply_eval(self, variables, args, kwargs):
+        return self.eval_fn(variables["params"], *args, **kwargs)
+
+
+def as_adapter(model: Any, **adapter_kwargs) -> ModelAdapter:
+    """Coerce user input to a ModelAdapter: an adapter instance, a flax
+    module (has ``.apply``), or a plain callable."""
+    if isinstance(model, ModelAdapter):
+        return model
+    if hasattr(model, "apply") and hasattr(model, "init"):
+        return FlaxModelAdapter(model, **adapter_kwargs)
+    if callable(model):
+        return FunctionalModelAdapter(model)
+    raise TypeError(
+        f"Stoke -- model must be a flax Module, a callable, or a ModelAdapter; "
+        f"got {type(model)}"
+    )
+
+
+# --------------------------------------------------------------------------- #
+# Deferred outputs (lazy model() handles)
+# --------------------------------------------------------------------------- #
+
+
+class DeferredOutput:
+    """Lazy handle returned by ``Stoke.model`` in train mode.
+
+    Records an extraction *path* (``out[0].logits`` → ``(("getitem", 0),
+    ("getattr", "logits"))``) instead of values, so ``loss()`` can substitute
+    the real forward output inside the compiled fused step — avoiding the
+    extra forward pass an eager ``model()`` would force.  ``.value``
+    materializes through a separate compiled forward with the SAME rng the
+    fused step will use, so dropout masks agree.
+    """
+
+    __slots__ = ("_materialize", "_token", "_path")
+
+    def __init__(self, materialize_fn, token: int, path: Tuple = ()):
+        object.__setattr__(self, "_materialize", materialize_fn)
+        object.__setattr__(self, "_token", token)
+        object.__setattr__(self, "_path", path)
+
+    def __getitem__(self, key):
+        return DeferredOutput(
+            self._materialize, self._token, self._path + (("getitem", key),)
+        )
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeferredOutput(
+            self._materialize, self._token, self._path + (("getattr", name),)
+        )
+
+    @property
+    def value(self):
+        """Materialize the real output (runs a compiled train-mode forward)."""
+        return apply_path(self._materialize(self._token), self._path)
+
+    def __array__(self, dtype=None):
+        return np.asarray(self.value, dtype=dtype)
+
+    def __repr__(self):
+        return f"DeferredOutput(token={self._token}, path={self._path})"
+
+
+def is_deferred(x) -> bool:
+    return isinstance(x, DeferredOutput)
+
+
+def apply_path(out, path: Tuple) -> Any:
+    for kind, key in path:
+        out = out[key] if kind == "getitem" else getattr(out, key)
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# Loss pytree helpers (multi-loss support; reference stoke.py:872-912)
+# --------------------------------------------------------------------------- #
+
+
+def flatten_losses(loss_result: Any) -> Tuple[list, Any]:
+    """User loss fns may return a scalar, tuple/list, or dict of scalars
+    (reference supports single + list/tuple, stoke.py:891-902).  Returns
+    (leaves, treedef)."""
+    leaves, treedef = jax.tree_util.tree_flatten(loss_result)
+    return leaves, treedef
+
+
+# --------------------------------------------------------------------------- #
+# Precision policy
+# --------------------------------------------------------------------------- #
+
+
+class PrecisionPolicy(NamedTuple):
+    """Dtype policy: fp32 master params, policy compute dtype, fp32 outputs
+    (replaces autocast contexts + GradScaler, reference fp16.py:694-806)."""
+
+    param_dtype: Any
+    compute_dtype: Optional[Any]  # None = no cast (full precision)
+    output_dtype: Optional[Any]
+    scaled: bool  # True only for fp16 (dynamic loss scaler active)
+
+    @staticmethod
+    def make(option: PrecisionOptions, cfg: PrecisionConfig) -> "PrecisionPolicy":
+        if option is PrecisionOptions.full:
+            return PrecisionPolicy(jnp.dtype(cfg.param_dtype), None, None, False)
+        if option is PrecisionOptions.bf16:
+            return PrecisionPolicy(
+                jnp.dtype(cfg.param_dtype),
+                jnp.bfloat16,
+                jnp.dtype(cfg.output_dtype),
+                False,
+            )
+        if option is PrecisionOptions.fp16:
+            return PrecisionPolicy(
+                jnp.dtype(cfg.param_dtype),
+                jnp.float16,
+                jnp.dtype(cfg.output_dtype),
+                True,
+            )
+        raise ValueError(option)
+
+    def cast_compute(self, tree):
+        return tree_cast(tree, self.compute_dtype)
+
+    def cast_output(self, tree):
+        return tree_cast(tree, self.output_dtype)
+
+
+def init_scaler_state(cfg: PrecisionConfig) -> Dict[str, Any]:
+    """Device-side dynamic loss-scaler state (functional GradScaler,
+    reference fp16.py:731-748)."""
+    return {
+        "scale": jnp.asarray(cfg.init_scale, jnp.float32),
+        "growth_count": jnp.asarray(0, jnp.int32),
+    }
+
+
+def _scaler_update(state, finite, cfg: PrecisionConfig):
+    """GradScaler.update() semantics (reference fp16.py:805-806): grow scale
+    after ``growth_interval`` consecutive finite steps, back off on overflow."""
+    grew = state["growth_count"] + 1 >= cfg.growth_interval
+    new_scale = jnp.where(
+        finite,
+        jnp.where(grew, state["scale"] * cfg.growth_factor, state["scale"]),
+        jnp.maximum(state["scale"] * cfg.backoff_factor, cfg.min_scale),
+    )
+    new_count = jnp.where(finite & ~grew, state["growth_count"] + 1, 0)
+    return {"scale": new_scale, "growth_count": new_count}
+
+
+# --------------------------------------------------------------------------- #
+# Gradient clipping (reference fp16.py:84-156 dispatch)
+# --------------------------------------------------------------------------- #
+
+
+def clip_gradients(grads, grad_clip) -> Any:
+    """Clip on the (already unscaled, logically-global) gradient pytree.
+
+    The reference needs five backend-specific clip implementations
+    (fp16.py:84-156: plain / scaler-unscaled / OSS synced-norm / FSDP
+    model-level / horovod-synchronize-first); under SPMD jit the gradients
+    are logically global, so one implementation serves every tier.
+    """
+    if grad_clip is None:
+        return grads
+    if isinstance(grad_clip, ClipGradConfig):
+        v = grad_clip.clip_value
+        return jax.tree_util.tree_map(lambda g: jnp.clip(g, -v, v), grads)
+    if isinstance(grad_clip, ClipGradNormConfig):
+        p = grad_clip.norm_type
+        leaves = jax.tree_util.tree_leaves(grads)
+        if p == np.inf:
+            norm = jnp.max(jnp.stack([jnp.max(jnp.abs(l)) for l in leaves]))
+        else:
+            norm = (
+                jnp.sum(
+                    jnp.stack(
+                        [jnp.sum(jnp.abs(l.astype(jnp.float32)) ** p) for l in leaves]
+                    )
+                )
+                ** (1.0 / p)
+            )
+        factor = jnp.minimum(1.0, grad_clip.max_norm / (norm + 1e-6))
+        return jax.tree_util.tree_map(lambda g: g * factor, grads)
+    raise TypeError(f"unknown grad_clip {type(grad_clip)}")
+
+
+# --------------------------------------------------------------------------- #
+# Optimizer build (reference extensions.py:30-78 BaseOptimizer)
+# --------------------------------------------------------------------------- #
+
+
+def build_optimizer(optimizer: Any) -> optax.GradientTransformation:
+    """Instantiate the optimizer from a StokeOptimizer TypedDict (constructor
+    + kwargs, reference configs.py:754-770) or accept an already-built optax
+    GradientTransformation."""
+    if isinstance(optimizer, optax.GradientTransformation):
+        return optimizer
+    if isinstance(optimizer, dict) and "optimizer" in optimizer:
+        ctor = optimizer["optimizer"]
+        kwargs = optimizer.get("optimizer_kwargs", {})
+        built = ctor(**kwargs)
+        if not isinstance(built, optax.GradientTransformation):
+            raise TypeError(
+                f"Stoke -- StokeOptimizer['optimizer'] must construct an optax "
+                f"GradientTransformation, got {type(built)}"
+            )
+        return built
+    if callable(optimizer):
+        built = optimizer()
+        if isinstance(built, optax.GradientTransformation):
+            return built
+    raise TypeError(
+        "Stoke -- optimizer must be an optax.GradientTransformation or a "
+        "StokeOptimizer dict {'optimizer': ctor, 'optimizer_kwargs': {...}}"
+    )
+
+
+# --------------------------------------------------------------------------- #
+# The engine
+# --------------------------------------------------------------------------- #
+
+
+class StepEngine:
+    """Owns the compiled step functions and the sharding contract.
+
+    One engine instance per ``Stoke`` facade.  All state (variables /
+    opt_state / grad buffer / scaler / rng) is held by the *facade* and passed
+    through; the engine is stateless apart from its jit caches, keeping the
+    functional core testable in isolation.
+    """
+
+    def __init__(
+        self,
+        adapter: ModelAdapter,
+        loss_fn: Callable,
+        optimizer: optax.GradientTransformation,
+        *,
+        precision: PrecisionPolicy,
+        precision_config: PrecisionConfig,
+        grad_accum: int,
+        grad_clip,
+        rules: Optional[ShardingRules],
+        remat: Optional[ActivationCheckpointingConfig] = None,
+    ):
+        self.adapter = adapter
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.precision = precision
+        self.precision_config = precision_config
+        self.grad_accum = int(grad_accum)
+        self.grad_clip = grad_clip
+        self.rules = rules
+        self.remat = remat
+        self._accum_cache: Dict[Any, Callable] = {}
+        self._fwd_cache: Dict[Any, Callable] = {}
+        self._loss_cache: Dict[Any, Callable] = {}
+        self._apply_fn: Optional[Callable] = None
+        # shardings, resolved lazily once variables are known
+        self._var_shardings = None
+        self._grad_shardings = None
+        self._opt_shardings = None
+        self._repl = None
+
+    # -------------------------- placement ----------------------------- #
+
+    def resolve_placement_abstract(self, variables, opt_state_shapes):
+        """Compute NamedSharding trees for all state pytrees from concrete
+        variables + *abstract* optimizer-state shapes, and return the
+        variables device_put onto their placement (the one-time analogue of
+        the reference's wrap ordering dance, stoke.py:306-324).  The optimizer
+        state itself is then created directly sharded by
+        :meth:`init_opt_state` — big models never hold a replicated opt state
+        (the ZeRO-1 memory win, reference extensions.py:81-141)."""
+        if self.rules is None:
+            return variables
+        params_sh = self.rules.param_shardings(variables["params"])
+        other = {k: v for k, v in variables.items() if k != "params"}
+        # non-param collections (BN stats etc.) follow the param rule; tiny
+        # leaves stay replicated via min_weight_size
+        other_sh = {k: self.rules.param_shardings(v) for k, v in other.items()}
+        self._var_shardings = {"params": params_sh, **other_sh}
+        self._grad_shardings = self.rules.grad_shardings(variables["params"])
+        self._opt_shardings = self.rules.opt_shardings(opt_state_shapes)
+        self._repl = self.rules.replicated()
+        return jax.device_put(variables, self._var_shardings)
+
+    def init_grad_buffer(self, variables):
+        """Zero accumulation buffer, sharded per the tier's grad rule
+        (SDDP/FSDP: 1/N memory — the ZeRO-2 win, reference
+        extensions.py:219-286)."""
+        zeros = tree_zeros_like(variables["params"])
+        if self._grad_shardings is not None:
+            zeros = jax.device_put(zeros, self._grad_shardings)
+        return zeros
+
+    def init_opt_state(self, variables):
+        """Optimizer-state init, created directly onto the tier's placement
+        via ``out_shardings`` (never materialized replicated)."""
+        if self._opt_shardings is not None:
+            init = jax.jit(self.optimizer.init, out_shardings=self._opt_shardings)
+            return init(variables["params"])
+        return self.optimizer.init(variables["params"])
+
+    # ----------------------- forward passes --------------------------- #
+
+    def _maybe_remat(self, fn):
+        if self.remat is None:
+            return fn
+        policy = getattr(jax.checkpoint_policies, self.remat.policy)
+        return jax.checkpoint(fn, policy=policy, prevent_cse=self.remat.prevent_cse)
+
+    def _run_forward_train(self, variables, rng, margs, mkwargs):
+        cvars = {
+            "params": self.precision.cast_compute(variables["params"]),
+            **{k: v for k, v in variables.items() if k != "params"},
+        }
+        cargs = self.precision.cast_compute(margs)
+        ckwargs = self.precision.cast_compute(mkwargs)
+        out, updated = self.adapter.apply_train(cvars, rng, cargs, ckwargs)
+        return self.precision.cast_output(out), updated
+
+    def train_fwd(self, variables, rng, margs: tuple, mkwargs: dict):
+        """Compiled train-mode forward for materializing DeferredOutputs.
+        Uses the same rng-derivation as the fused step so dropout agrees."""
+        key = ("fwd", jax.tree_util.tree_structure((margs, mkwargs)))
+        if key not in self._fwd_cache:
+
+            @jax.jit
+            def _fwd(variables, rng, margs, mkwargs):
+                sub = jax.random.split(rng)[1]
+                out, _ = self._run_forward_train(variables, sub, margs, mkwargs)
+                return out
+
+            self._fwd_cache[key] = _fwd
+        return self._fwd_cache[key](variables, rng, margs, mkwargs)
+
+    def eval_fwd(self, variables, margs: tuple, mkwargs: dict):
+        key = ("eval", jax.tree_util.tree_structure((margs, mkwargs)))
+        if key not in self._fwd_cache:
+
+            @jax.jit
+            def _efwd(variables, margs, mkwargs):
+                cvars = {
+                    "params": self.precision.cast_compute(variables["params"]),
+                    **{k: v for k, v in variables.items() if k != "params"},
+                }
+                cargs = self.precision.cast_compute(margs)
+                ckwargs = self.precision.cast_compute(mkwargs)
+                out = self.adapter.apply_eval(cvars, cargs, ckwargs)
+                return self.precision.cast_output(out)
+
+            self._fwd_cache[key] = _efwd
+        return self._fwd_cache[key](variables, margs, mkwargs)
+
+    # -------------------------- fused micro-step ----------------------- #
+
+    def accum_step(
+        self,
+        variables,
+        grad_buf,
+        scaler_state,
+        rng,
+        margs: tuple,
+        mkwargs: dict,
+        loss_args_flat: list,
+        loss_treedef,
+        deferred_info: Tuple[Tuple[int, Tuple], ...],
+        training: bool,
+    ):
+        """One compiled micro-step: forward + loss + grad + buffer add.
+
+        ``loss_args_flat``/``loss_treedef`` are the flattened (args, kwargs)
+        of the user's ``loss()`` call with DeferredOutput leaves removed;
+        ``deferred_info`` records (flat_index, extraction_path) for each
+        removed leaf so the real forward output is substituted inside the
+        trace.  Returns (loss_tree, updated_nonparam_vars, new_grad_buf,
+        new_rng) — all device-resident; nothing syncs to host
+        (SURVEY.md §3.2 observation (a)).
+        """
+        struct_key = (
+            "accum",
+            jax.tree_util.tree_structure((margs, mkwargs)),
+            loss_treedef,
+            deferred_info,
+            training,
+        )
+        if struct_key not in self._accum_cache:
+            self._accum_cache[struct_key] = self._build_accum(
+                loss_treedef, deferred_info, training
+            )
+        return self._accum_cache[struct_key](
+            variables, grad_buf, scaler_state, rng, margs, mkwargs, loss_args_flat
+        )
+
+    def _build_accum(self, loss_treedef, deferred_info, training):
+        inv_scale_accum = 1.0 / self.grad_accum if training else 1.0
+        scaled = self.precision.scaled
+
+        def _loss_from_out(out, loss_args_flat):
+            flat = list(loss_args_flat)
+            # re-insert deferred leaves (extracted views of the forward out)
+            for idx, path in deferred_info:
+                flat.insert(idx, apply_path(out, path))
+            largs, lkwargs = jax.tree_util.tree_unflatten(loss_treedef, flat)
+            return self.loss_fn(*largs, **lkwargs)
+
+        def _step(variables, grad_buf, scaler_state, rng, margs, mkwargs, larr):
+            new_rng, sub = jax.random.split(rng)
+            scale = scaler_state["scale"] if scaled else jnp.float32(1.0)
+
+            def lf(params):
+                vars_in = {**variables, "params": params}
+                fwd = self._maybe_remat(
+                    lambda v: self._run_forward_train(v, sub, margs, mkwargs)
+                )
+                out, updated = fwd(vars_in)
+                loss_result = _loss_from_out(out, larr)
+                leaves, inner_def = jax.tree_util.tree_flatten(loss_result)
+                total = sum(jnp.asarray(l, jnp.float32).sum() for l in leaves)
+                # reference divides the training loss by grad_accum at loss()
+                # time (stoke.py:901-911); fp16 additionally scales for the
+                # dynamic scaler.
+                objective = total * inv_scale_accum * scale
+                report = jax.tree_util.tree_unflatten(
+                    inner_def, [l * inv_scale_accum for l in leaves]
+                )
+                return objective, (report, updated)
+
+            if training:
+                grads, (report, updated) = jax.grad(lf, has_aux=True)(
+                    variables["params"]
+                )
+                new_buf = jax.tree_util.tree_map(
+                    lambda b, g: b + g.astype(b.dtype), grad_buf, grads
+                )
+            else:
+                _, (report, updated) = lf(variables["params"])
+                new_buf = grad_buf
+            return report, updated, new_buf, new_rng
+
+        if self.rules is not None:
+            # Pin state outputs to the tier's placement so step-to-step
+            # placement is deterministic (GSPMD would otherwise be free to
+            # drift, changing collective schedules between steps).
+            repl = self._repl
+            out_sh = (
+                None,  # loss report: let XLA keep it replicated (scalars)
+                None,  # updated non-param collections: follow inputs
+                self._grad_shardings,
+                repl,  # rng
+            )
+            return jax.jit(_step, out_shardings=out_sh)
+        return jax.jit(_step)
+
+    # ---------------------------- apply step --------------------------- #
+
+    def apply_step(self, variables, opt_state, grad_buf, scaler_state):
+        """Compiled optimizer application: unscale → finite-check → clip →
+        update → zero buffer → scaler update (reference step() path,
+        stoke.py:990-1040 + fp16.py:788-806)."""
+        if self._apply_fn is None:
+            self._apply_fn = self._build_apply()
+        return self._apply_fn(variables, opt_state, grad_buf, scaler_state)
+
+    def _build_apply(self):
+        scaled = self.precision.scaled
+        cfg = self.precision_config
+        grad_clip = self.grad_clip
+        optimizer = self.optimizer
+
+        def _apply(variables, opt_state, grad_buf, scaler_state):
+            params = variables["params"]
+            inv = 1.0 / scaler_state["scale"] if scaled else jnp.float32(1.0)
+            grads = jax.tree_util.tree_map(lambda g: g * inv, grad_buf)
+            finite = tree_finite(grads) if scaled else jnp.asarray(True)
+            grads = clip_gradients(grads, grad_clip)
+
+            def do_update(_):
+                updates, new_opt = optimizer.update(grads, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
+                return new_params, new_opt
+
+            def skip_update(_):
+                return params, opt_state
+
+            new_params, new_opt = jax.lax.cond(finite, do_update, skip_update, None)
+            new_scaler = (
+                _scaler_update(scaler_state, finite, cfg) if scaled else scaler_state
+            )
+            new_vars = {**variables, "params": new_params}
+            zero_buf = tree_zeros_like(grad_buf)
+            return new_vars, new_opt, zero_buf, new_scaler, finite
+
+        if self.rules is not None:
+            out_sh = (
+                self._var_shardings,
+                self._opt_shardings,
+                self._grad_shardings,
+                {"scale": self._repl, "growth_count": self._repl},
+                self._repl,
+            )
+            return jax.jit(_apply, out_shardings=out_sh, donate_argnums=(0, 1, 2))
+        return jax.jit(_apply, donate_argnums=(0, 1, 2))
+
+    # --------------------------- loss-only ----------------------------- #
+
+    def loss_eval(self, loss_args_flat, loss_treedef):
+        """Compiled loss-only evaluation (eval mode; outputs are real arrays
+        so no substitution is needed)."""
+        key = ("loss", loss_treedef)
+        if key not in self._loss_cache:
+
+            @jax.jit
+            def _loss(flat):
+                largs, lkwargs = jax.tree_util.tree_unflatten(loss_treedef, flat)
+                return self.loss_fn(*largs, **lkwargs)
+
+            self._loss_cache[key] = _loss
+        return self._loss_cache[key](loss_args_flat)

@@ -1,0 +1,780 @@
+"""The `Stoke` facade: declarative flags → validated status → one SPMD engine.
+
+TPU-native re-design of the reference facade (stoke/stoke.py:49-1466).  The
+public contract is preserved — construct with flags, then drive your own
+training loop through four wrapped calls plus a DataLoader factory and
+unified save/load (reference README.md:13-43):
+
+    stoke = Stoke(model, optimizer, loss, batch_size_per_device=32,
+                  device="tpu", distributed="dp", precision="bf16", fsdp=True)
+    loader = stoke.DataLoader(dataset, sampler=...)
+    for x, y in loader:
+        out = stoke.model(x)          # lazy handle (train) / eager (eval)
+        loss = stoke.loss(out, y)     # ONE compiled fused micro-step
+        stoke.backward(loss)          # commit accumulated grads
+        stoke.step()                  # compiled apply at accum boundary
+
+What changed under the hood (SURVEY.md §7): the reference's dynamically
+composed mixin runner (``type("StokeRunner", (dist, fp16, opt, io))``,
+stoke.py:599-657) becomes explicit strategy *data* — a device mesh, sharding
+rules, a precision policy, and compiled step functions.  There is no wrap
+ordering dance (stoke.py:306-324): placement is declared once and XLA derives
+the collective schedule.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from stoke_tpu.configs import (
+    ClipGradConfig,
+    ClipGradNormConfig,
+    DeviceOptions,
+    DistributedOptions,
+    ParamNormalize,
+    PrecisionOptions,
+    LossReduction,
+)
+from stoke_tpu.engine import (
+    DeferredOutput,
+    PrecisionPolicy,
+    StepEngine,
+    as_adapter,
+    build_optimizer,
+    init_scaler_state,
+    is_deferred,
+)
+from stoke_tpu.parallel.mesh import build_mesh, initialize_distributed
+from stoke_tpu.parallel.sharding import make_sharding_rules
+from stoke_tpu.status import StokeStatus
+from stoke_tpu.utils.printing import unrolled_print
+from stoke_tpu.utils.trees import tree_count_params
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+class Stoke:
+    """Declarative training-context facade (reference stoke/stoke.py:49-1466).
+
+    Args:
+        model: flax ``linen.Module``, plain callable ``fn(params, *args)``,
+            or a :class:`~stoke_tpu.engine.ModelAdapter`.
+        optimizer: ``StokeOptimizer`` TypedDict (ctor + kwargs, reference
+            configs.py:754-770) or an ``optax.GradientTransformation``.
+        loss: callable ``loss(out, *targets) -> scalar | tuple | dict``
+            (multi-loss supported, reference stoke.py:872-912).
+        params: initial model variables — either a flax variables dict
+            (``{"params": ..., "batch_stats": ...}``) or a bare params pytree.
+            (The reference receives an initialized ``nn.Module``; JAX splits
+            module and state, so state is passed explicitly.)
+        batch_size_per_device: micro-batch size per device.
+        grad_accum: gradient accumulation steps (reference stoke.py:137).
+        grad_clip: ``ClipGradConfig`` / ``ClipGradNormConfig`` / None.
+        device: "cpu" | "tpu" (reference ``gpu`` flag).
+        distributed: None | "dp" (reference {ddp,horovod,deepspeed} collapse).
+        precision: None/"full" | "bf16" | "fp16" (reference FP16Options).
+        oss / sddp / fsdp: ZeRO-1/2/3-equivalent sharding tiers (reference
+            fairscale flags, stoke.py:147-152).
+        configs: list of config-class instances (deduped by class).
+        model_train_kwargs / model_eval_kwargs: extra kwargs for flax apply
+            in train/eval mode (e.g. ``{"train": True}``), replacing torch's
+            implicit module mode bit.
+        seed: PRNG seed for dropout etc.
+        ema_weight: EMA coefficient for the rolling loss (reference
+            stoke.py:155 ``ema_weight``).
+        verbose: rank-0 status printing (reference stoke.py:154).
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        optimizer: Any,
+        loss: Callable,
+        params: Any,
+        batch_size_per_device: int,
+        grad_accum: Optional[int] = None,
+        grad_clip: Optional[Union[ClipGradConfig, ClipGradNormConfig]] = None,
+        device: Union[str, DeviceOptions] = "cpu",
+        distributed: Optional[Union[str, DistributedOptions]] = None,
+        precision: Optional[Union[str, PrecisionOptions]] = None,
+        oss: bool = False,
+        sddp: bool = False,
+        fsdp: bool = False,
+        configs: Optional[Sequence[Any]] = None,
+        model_train_kwargs: Optional[dict] = None,
+        model_eval_kwargs: Optional[dict] = None,
+        model_rng_keys: Sequence[str] = ("dropout",),
+        seed: int = 0,
+        ema_weight: float = 0.1,
+        verbose: bool = True,
+    ):
+        # ----- L3: validated status (reference stoke.py:201) -----
+        self._status_obj = StokeStatus(
+            batch_size_per_device=batch_size_per_device,
+            grad_accum=grad_accum,
+            grad_clip=grad_clip,
+            device=device,
+            distributed=distributed,
+            precision=precision,
+            oss=oss,
+            sddp=sddp,
+            fsdp=fsdp,
+            configs=configs,
+        )
+        st = self._status_obj
+        self._verbose = verbose
+
+        # ----- multi-host rendezvous + mesh (reference setup_distributed,
+        #       stoke.py:220 → distributed.py:491-538) -----
+        if st.is_distributed and st.dist_init_config.auto_initialize:
+            initialize_distributed(st.dist_init_config)
+        self._mesh = build_mesh(st.mesh_config, st.device, st.is_distributed)
+        self._rules = make_sharding_rules(
+            st.sharding_tier,
+            self._mesh,
+            st.dp_config.axis_name,
+            st.oss_config,
+            st.sddp_config,
+            st.fsdp_config,
+        )
+        if self._mesh is None:
+            backend = "cpu" if st.device is DeviceOptions.cpu else None
+            self._device = jax.devices(backend)[0] if backend else jax.devices()[0]
+        else:
+            self._device = None
+
+        # ----- model / loss / optimizer checks (reference stoke.py:214-216) -----
+        self._adapter = as_adapter(
+            model,
+            **(
+                dict(
+                    train_kwargs=model_train_kwargs,
+                    eval_kwargs=model_eval_kwargs,
+                    rng_keys=model_rng_keys,
+                )
+                if hasattr(model, "apply") and not isinstance(model, StepEngine)
+                else {}
+            ),
+        )
+        if not callable(loss):
+            raise TypeError("Stoke -- loss must be callable")
+        self._loss_fn = loss
+        self._optimizer = build_optimizer(optimizer)
+
+        # ----- state -----
+        variables = params
+        if not (isinstance(variables, dict) and "params" in variables):
+            variables = {"params": variables}
+        self._precision = PrecisionPolicy.make(st.precision, st.precision_config)
+        self._engine = StepEngine(
+            self._adapter,
+            self._loss_fn,
+            self._optimizer,
+            precision=self._precision,
+            precision_config=st.precision_config,
+            grad_accum=st.grad_accum,
+            grad_clip=st.grad_clip,
+            rules=self._rules,
+            remat=st.activation_checkpointing_config,
+        )
+        if self._rules is not None:
+            opt_shapes = jax.eval_shape(self._optimizer.init, variables["params"])
+            variables = self._engine.resolve_placement_abstract(variables, opt_shapes)
+            self._variables = variables
+            self._opt_state = self._engine.init_opt_state(variables)
+        else:
+            self._variables = jax.device_put(variables, self._device)
+            self._opt_state = jax.device_put(
+                self._optimizer.init(self._variables["params"]), self._device
+            )
+        self._grad_buf = self._engine.init_grad_buffer(self._variables)
+        self._scaler_state = self._place_scalar_tree(
+            init_scaler_state(st.precision_config)
+        )
+        self._rng = self._place_scalar_tree(jax.random.PRNGKey(seed))
+
+        # ----- counters (reference stoke.py:237-243) -----
+        self._grad_accum_counter = 0
+        self._optimizer_steps = 0
+        self._backward_steps = 0
+        self._agg_loss = self._zero_scalar()
+        self._agg_count = 0
+        self._rolling_mean_loss = self._zero_scalar()
+        self._ema_weight = float(ema_weight)
+        self._skipped_steps = self._zero_scalar()
+        self._last_step_loss = None
+
+        # ----- lazy-step bookkeeping -----
+        self._training = True
+        self._token = 0
+        self._stashed_model_call: Optional[tuple] = None
+        self._pending: Optional[tuple] = None  # (new_grad_buf, token)
+
+        # ----- post-init status (reference stoke.py:245) -----
+        world = self._mesh.size if self._mesh is not None else 1
+        st.set_post_init_values(world, n_processes=jax.process_count())
+        if self._verbose and self.is_rank_0:
+            unrolled_print(repr(st).splitlines())
+
+    # ------------------------------------------------------------------ #
+    # placement helpers
+    # ------------------------------------------------------------------ #
+
+    def _zero_scalar(self):
+        return self._place_scalar_tree(jnp.float32(0.0))
+
+    def _place_scalar_tree(self, tree):
+        if self._rules is not None:
+            repl = self._rules.replicated()
+            return jax.tree_util.tree_map(lambda x: jax.device_put(x, repl), tree)
+        return jax.device_put(tree, self._device)
+
+    def _batch_sharding_for(self, shape):
+        if self._mesh is None:
+            return self._device
+        axis = self._rules.axis_name
+        if shape and shape[0] % self._mesh.shape[axis] == 0:
+            return NamedSharding(self._mesh, P(axis))
+        return NamedSharding(self._mesh, P())
+
+    def _place_batch(self, tree):
+        """Host batch → device, sharded over the data axis (the TPU
+        equivalent of ``place_data_on_gpu``, reference utils.py:39-80; for
+        multi-host, each process contributes its local slice of the
+        logically-global batch)."""
+
+        def _leaf(x):
+            if isinstance(x, jax.Array):
+                return x
+            if hasattr(x, "detach"):  # torch tensor
+                x = x.detach().cpu().numpy()
+            x = np.asarray(x)
+            sh = self._batch_sharding_for(x.shape)
+            if self._mesh is not None and jax.process_count() > 1:
+                return jax.make_array_from_process_local_data(sh, x)
+            return jax.device_put(x, sh)
+
+        return jax.tree_util.tree_map(_leaf, tree)
+
+    # ------------------------------------------------------------------ #
+    # mode toggles (torch module.train()/eval() equivalent)
+    # ------------------------------------------------------------------ #
+
+    def train(self) -> "Stoke":
+        self._training = True
+        return self
+
+    def eval(self) -> "Stoke":
+        self._training = False
+        return self
+
+    @property
+    def training(self) -> bool:
+        return self._training
+
+    # ------------------------------------------------------------------ #
+    # the 4-call contract
+    # ------------------------------------------------------------------ #
+
+    def model(self, *args, **kwargs):
+        """Wrapped forward (reference stoke.py:853-869).
+
+        Train mode: returns a lazy :class:`DeferredOutput`; the actual
+        forward runs fused with loss+grad inside ``loss()`` (one dispatch per
+        micro-batch).  Eval mode: runs the compiled eval forward eagerly and
+        returns real arrays.
+        """
+        placed_args = self._place_batch(args)
+        placed_kwargs = self._place_batch(kwargs)
+        if self._training:
+            self._token += 1
+            self._stashed_model_call = (placed_args, placed_kwargs, self._token)
+            return DeferredOutput(self._materialize, self._token)
+        return self._engine.eval_fwd(self._variables, placed_args, placed_kwargs)
+
+    def _materialize(self, token: int):
+        if self._stashed_model_call is None or self._stashed_model_call[2] != token:
+            raise RuntimeError(
+                "Stoke -- stale DeferredOutput: materialize before the next "
+                "model() call"
+            )
+        margs, mkwargs, _ = self._stashed_model_call
+        return self._engine.train_fwd(self._variables, self._rng, margs, mkwargs)
+
+    def loss(self, *args, **kwargs):
+        """Wrapped loss (reference stoke.py:872-912).
+
+        Train mode: runs the compiled fused micro-step (forward + loss +
+        grad + buffer-accumulate) and returns device-scalar losses already
+        divided by ``grad_accum`` (reference stoke.py:901-911).  The
+        cross-replica loss sync of the reference (.item() + allreduce every
+        micro-batch, distributed.py:619-646) is free here: the loss is
+        computed over the logically-global batch.
+        """
+        flat, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=is_deferred
+        )
+        deferred_info = []
+        arrays = []
+        for i, leaf in enumerate(flat):
+            if is_deferred(leaf):
+                if (
+                    self._stashed_model_call is None
+                    or leaf._token != self._stashed_model_call[2]
+                ):
+                    raise RuntimeError(
+                        "Stoke -- loss() received a DeferredOutput from a "
+                        "previous model() call; call model() then loss() in "
+                        "order"
+                    )
+                deferred_info.append((i, leaf._path))
+            else:
+                arrays.append(leaf)
+        if self._training and deferred_info:
+            margs, mkwargs, token = self._stashed_model_call
+            arrays = self._place_batch(arrays)
+            report, updated, new_buf, new_rng = self._engine.accum_step(
+                self._variables,
+                self._grad_buf,
+                self._scaler_state,
+                self._rng,
+                margs,
+                mkwargs,
+                arrays,
+                treedef,
+                tuple(deferred_info),
+                True,
+            )
+            self._rng = new_rng
+            if updated:
+                self._variables = {**self._variables, **updated}
+            self._pending = (new_buf, token)
+            self._update_loss_tracking(report)
+            return report
+        # eval path (or no deferred handle): materialize + loss-only
+        full = [leaf.value if is_deferred(leaf) else leaf for leaf in flat]
+        placed = self._place_batch(full)
+        report = self._engine.loss_eval(placed, treedef)
+        if self._training:
+            # keep the fused-path convention: training losses are returned
+            # divided by grad_accum (reference stoke.py:901-911)
+            inv = 1.0 / self._status_obj.grad_accum
+            report = jax.tree_util.tree_map(lambda l: l * inv, report)
+            self._update_loss_tracking(report)
+        return report
+
+    def backward(self, loss: Any = None) -> None:
+        """Wrapped backward (reference stoke.py:960-988): commits the grads
+        of the last ``loss()`` into the accumulation buffer and advances the
+        micro-step counters.  The gradients were already computed inside the
+        fused step; an uncommitted pending buffer is simply dropped, so
+        "no backward → no gradient contribution" holds."""
+        if not self._training:
+            raise RuntimeError("Stoke -- backward() called in eval mode")
+        if self._pending is None:
+            raise RuntimeError(
+                "Stoke -- backward() called without a preceding loss() on a "
+                "model() output"
+            )
+        new_buf, _ = self._pending
+        self._grad_buf = new_buf
+        self._pending = None
+        self._grad_accum_counter += 1
+        self._backward_steps += 1
+
+    def step(self) -> None:
+        """Wrapped optimizer step (reference stoke.py:990-1040): at the
+        accumulation boundary runs the compiled apply (unscale → finite-check
+        → clip → update → zero buffer → scaler update); otherwise a no-op.
+        """
+        if self._grad_accum_counter < self._status_obj.grad_accum:
+            return
+        (
+            self._variables,
+            self._opt_state,
+            self._grad_buf,
+            self._scaler_state,
+            finite,
+        ) = self._engine.apply_step(
+            self._variables, self._opt_state, self._grad_buf, self._scaler_state
+        )
+        if self._precision.scaled:
+            self._skipped_steps = self._skipped_steps + (
+                1.0 - finite.astype(jnp.float32)
+            )
+        self._optimizer_steps += 1
+        self._grad_accum_counter = 0
+        self._reset_tracking_window()
+
+    def reset(self) -> None:
+        """Zero the accumulation buffer and counters without stepping
+        (reference ``reset`` helpers, stoke.py:1042-1058)."""
+        self._grad_buf = self._engine.init_grad_buffer(self._variables)
+        self._grad_accum_counter = 0
+        self._pending = None
+        self._reset_tracking_window()
+
+    # ------------------------------------------------------------------ #
+    # loss tracking (reference stoke.py:371-520, :914-958)
+    # ------------------------------------------------------------------ #
+
+    def _loss_total(self, report) -> jax.Array:
+        leaves = jax.tree_util.tree_leaves(report)
+        total = leaves[0]
+        for l in leaves[1:]:
+            total = total + l
+        return total
+
+    def _update_loss_tracking(self, report) -> None:
+        # losses arrive divided by grad_accum; track the undivided micro loss
+        micro = self._loss_total(report) * self._status_obj.grad_accum
+        self._last_step_loss = micro
+        self._agg_loss = self._agg_loss + micro
+        self._agg_count += 1
+        w = self._ema_weight
+        self._rolling_mean_loss = jnp.where(
+            self._backward_steps + self._agg_count <= 1,
+            micro,
+            (1.0 - w) * self._rolling_mean_loss + w * micro,
+        )
+
+    def _reset_tracking_window(self) -> None:
+        self._agg_loss = self._zero_scalar()
+        self._agg_count = 0
+
+    def detach_and_sync_loss(self, loss: Any) -> float:
+        """Host float of a (possibly structured) loss, synced across the mesh
+        (reference detach_and_sync_loss, distributed.py:619-646 — there a
+        barrier + allreduce + ``.item()``; here the value is already the
+        global-batch loss, so this is just the host transfer)."""
+        val = float(jax.device_get(self._loss_total(loss)))
+        if self._status_obj.dp_config.loss_reduction is LossReduction.sum:
+            val *= self.world_size
+        return val
+
+    @property
+    def ema_loss(self) -> float:
+        """Rolling EMA of the (undivided) micro losses (reference
+        stoke.py:914-958)."""
+        return float(jax.device_get(self._rolling_mean_loss))
+
+    @property
+    def step_loss(self) -> Optional[float]:
+        if self._last_step_loss is None:
+            return None
+        return float(jax.device_get(self._last_step_loss))
+
+    @property
+    def mean_accumulated_loss(self) -> Optional[float]:
+        if self._agg_count == 0:
+            return None
+        return float(jax.device_get(self._agg_loss)) / self._agg_count
+
+    def print_ema_loss(self, prepend_msg: str = "EMA Loss") -> None:
+        """(reference print_ema_loss, stoke.py:447-460)"""
+        self.print_on_devices(f"{prepend_msg}: {self.ema_loss:.6f}")
+
+    def print_mean_accumulated_synced_loss(
+        self, prepend_msg: str = "Mean accumulated loss"
+    ) -> None:
+        """(reference stoke.py:462-482)"""
+        v = self.mean_accumulated_loss
+        self.print_on_devices(
+            f"{prepend_msg}: {v:.6f}" if v is not None else f"{prepend_msg}: n/a"
+        )
+
+    def print_synced_loss(
+        self, loss: Any, prepend_msg: str = "Step loss", scale_by_accum: bool = True
+    ) -> None:
+        """(reference print_synced_loss, stoke.py:484-505)"""
+        v = self.detach_and_sync_loss(loss)
+        if scale_by_accum:
+            v *= self._status_obj.grad_accum
+        self.print_on_devices(f"{prepend_msg}: {v:.6f}")
+
+    # ------------------------------------------------------------------ #
+    # printing / rank helpers (reference distributed.py:238-271)
+    # ------------------------------------------------------------------ #
+
+    @property
+    def rank(self) -> int:
+        """Process index (reference rank property; on TPU, one process per
+        host, each feeding its local devices)."""
+        return jax.process_index()
+
+    @property
+    def is_rank_0(self) -> bool:
+        return self.rank == 0
+
+    @property
+    def world_size(self) -> int:
+        return self._status_obj.world_size or 1
+
+    @property
+    def n_processes(self) -> int:
+        return jax.process_count()
+
+    def print_on_devices(self, msg: str, rank: Optional[int] = 0) -> None:
+        """Print on a specific process rank, or all when rank=None
+        (reference print_device, distributed.py:238-271)."""
+        if rank is None or self.rank == rank:
+            unrolled_print(f"(rank {self.rank}) {msg}")
+
+    def info(self, msg: str) -> None:
+        if self.is_rank_0:
+            unrolled_print(f"INFO: {msg}")
+
+    def warn(self, msg: str) -> None:
+        if self.is_rank_0:
+            unrolled_print(f"WARN: {msg}")
+
+    def barrier(self) -> None:
+        """Cross-process sync (reference barrier/hvd.join,
+        distributed.py:671-692).  In-step SPMD needs no barriers; this exists
+        for host-side coordination around IO."""
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("stoke_barrier")
+
+    def block_until_ready(self) -> None:
+        """Wait for all in-flight device work (bench/test helper)."""
+        jax.block_until_ready(
+            (self._variables, self._opt_state, self._grad_buf)
+        )
+
+    # ------------------------------------------------------------------ #
+    # DataLoader factory (reference stoke.py:737-851)
+    # ------------------------------------------------------------------ #
+
+    def DataLoader(self, dataset, **kwargs):
+        """Build a :class:`~stoke_tpu.data.StokeDataLoader` wired to this
+        run's topology: the per-process loader batch is
+        ``batch_size_per_device × local-mesh-share`` and batches land sharded
+        over the mesh data axis (reference stoke.py:737-851 + SURVEY.md §3.3).
+        A DistributedSampler is required when multiple processes each load a
+        slice (reference stoke.py:822-826)."""
+        from stoke_tpu.data import StokeDataLoader
+
+        world = self.world_size
+        per_process = world // max(jax.process_count(), 1)
+        batch_size = self._status_obj.batch_size * max(per_process, 1)
+        if jax.process_count() > 1 and kwargs.get("sampler") is None:
+            raise ValueError(
+                "Stoke -- multi-process runs require a distributed sampler "
+                "(see BucketedDistributedSampler / DistributedSampler) — "
+                "reference stoke.py:822-826"
+            )
+        return StokeDataLoader(
+            dataset,
+            batch_size=batch_size,
+            place_fn=self._place_batch,
+            **kwargs,
+        )
+
+    # ------------------------------------------------------------------ #
+    # save / load (reference stoke.py:1060-1142)
+    # ------------------------------------------------------------------ #
+
+    def save(
+        self,
+        path: str,
+        name: str = "stoke",
+        extras: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        """Unified checkpoint save (reference stoke.py:1060-1106).  Layout is
+        chosen by ``CheckpointConfig.format``; the payload schema mirrors the
+        reference (io_ops.py:224-236): counters, status dict, model/optimizer
+        /scaler state, user extras."""
+        from stoke_tpu import io_ops
+
+        return io_ops.save_checkpoint(
+            path=path,
+            name=name,
+            variables=self._variables,
+            opt_state=self._opt_state,
+            scaler_state=self._scaler_state,
+            counters={
+                "backward_step": self._backward_steps,
+                "grad_accum_step": self._grad_accum_counter,
+                "optimizer_step": self._optimizer_steps,
+            },
+            status=self._status_obj.to_dict(),
+            extras=extras,
+            config=self._status_obj.checkpoint_config,
+            backward_step=self._backward_steps,
+            grad_buf=self._grad_buf if self._grad_accum_counter > 0 else None,
+        )
+
+    def load(
+        self, path: str, tag: Optional[str] = None, name: str = "stoke"
+    ) -> Dict[str, Any]:
+        """Unified checkpoint load (reference stoke.py:1108-1142): restores
+        model/optimizer/scaler state *onto the current sharding layout* (the
+        FSDP shard-extraction of the reference, io_ops.py:298-306, is just
+        "load into the declared shardings" here) and the step counters.  A
+        mid-accumulation-window save restores its partial gradient buffer;
+        if the checkpoint carries none, the window restarts cleanly."""
+        from stoke_tpu import io_ops
+
+        payload = io_ops.load_checkpoint(
+            path=path,
+            tag=tag,
+            variables_like=self._variables,
+            opt_state_like=self._opt_state,
+            scaler_like=self._scaler_state,
+            config=self._status_obj.checkpoint_config,
+            name=name if tag is None else None,
+            grad_buf_like=self._grad_buf,
+        )
+        self._variables = payload["variables"]
+        self._opt_state = payload["opt_state"]
+        self._scaler_state = payload["scaler_state"]
+        counters = payload["counters"]
+        self._backward_steps = counters["backward_step"]
+        self._optimizer_steps = counters["optimizer_step"]
+        if payload.get("grad_buf") is not None:
+            self._grad_buf = payload["grad_buf"]
+            self._grad_accum_counter = counters["grad_accum_step"]
+        else:
+            # no saved buffer → restart the accumulation window from zero
+            # rather than under-filling the next optimizer step
+            self._grad_buf = self._engine.init_grad_buffer(self._variables)
+            self._grad_accum_counter = 0
+        return payload.get("extras") or {}
+
+    # ------------------------------------------------------------------ #
+    # introspection properties (reference stoke.py:1271-1466)
+    # ------------------------------------------------------------------ #
+
+    @property
+    def status(self) -> Dict[str, Any]:
+        return self._status_obj.status
+
+    @property
+    def model_access(self):
+        """The underlying model adapter (reference model_access property)."""
+        return self._adapter
+
+    @property
+    def loss_access(self) -> Callable:
+        return self._loss_fn
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    @property
+    def variables(self) -> Dict[str, Any]:
+        return self._variables
+
+    @property
+    def params(self) -> Any:
+        return self._variables["params"]
+
+    @property
+    def opt_state(self) -> Any:
+        return self._opt_state
+
+    @property
+    def scaler(self) -> Dict[str, Any]:
+        """Loss-scaler state (reference scaler property / fp16_state_dict,
+        stoke.py:1300-1316)."""
+        return self._scaler_state
+
+    @property
+    def loss_scale(self) -> float:
+        return float(jax.device_get(self._scaler_state["scale"]))
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def sharding_rules(self):
+        return self._rules
+
+    @property
+    def batch_size(self) -> int:
+        return self._status_obj.batch_size
+
+    @property
+    def effective_batch_size(self) -> int:
+        return self._status_obj.effective_batch_size
+
+    @property
+    def grad_accum_steps(self) -> int:
+        return self._status_obj.grad_accum
+
+    @property
+    def grad_clip(self):
+        return self._status_obj.grad_clip
+
+    @property
+    def grad_accum_counter(self) -> int:
+        return self._grad_accum_counter
+
+    @property
+    def optimizer_steps(self) -> int:
+        return self._optimizer_steps
+
+    @property
+    def backward_steps(self) -> int:
+        return self._backward_steps
+
+    @property
+    def skipped_optimizer_steps(self) -> float:
+        """fp16 steps skipped on overflow (GradScaler semantics)."""
+        return float(jax.device_get(self._skipped_steps))
+
+    @property
+    def is_distributed(self) -> bool:
+        return self._status_obj.is_distributed
+
+    @property
+    def is_scaled_precision(self) -> bool:
+        return self._status_obj.is_scaled_precision
+
+    @property
+    def precision(self) -> PrecisionOptions:
+        return self._status_obj.precision
+
+    @property
+    def oss(self) -> bool:
+        return self._status_obj.oss
+
+    @property
+    def sddp(self) -> bool:
+        return self._status_obj.sddp
+
+    @property
+    def fsdp(self) -> bool:
+        return self._status_obj.fsdp
+
+    def num_model_parameters(
+        self, normalize: Optional[ParamNormalize] = None
+    ) -> float:
+        """Total parameter count (reference stoke.py:1144-1162)."""
+        n = tree_count_params(self._variables["params"])
+        return n / normalize.value if normalize is not None else n
+
+    def print_num_model_parameters(
+        self, normalize: Optional[ParamNormalize] = None
+    ) -> None:
+        n = self.num_model_parameters(normalize)
+        suffix = f" ({normalize.name})" if normalize else ""
+        self.print_on_devices(f"Model parameters: {n}{suffix}")
+
+    def dump_model_parameter_info(self) -> None:
+        """Per-leaf name/shape/dtype dump (reference stoke.py:1226-1240)."""
+        flat = jax.tree_util.tree_flatten_with_path(self._variables["params"])[0]
+        for path, leaf in flat:
+            name = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+            self.print_on_devices(
+                f"param {name}: shape={tuple(leaf.shape)} dtype={leaf.dtype}"
+            )

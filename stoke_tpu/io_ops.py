@@ -1,0 +1,263 @@
+"""Unified checkpoint save/load across sharding tiers.
+
+TPU-native re-design of the reference IO mixins (stoke/io_ops.py:20-746).
+The reference needs four strategies (BaseStokeIO/DDPIO/HorovodIO/DeepspeedIO)
+because each backend owns state differently (FSDP shard gathering
+io_ops.py:569-600, OSS consolidation :584, DeepSpeed engine checkpoints
+:389-544).  Here state is a pytree with *declared* shardings, so there are
+exactly two layouts:
+
+- ``consolidated``: gather to host and write one portable file set (numpy
+  arrays + JSON metadata) — the reference's rank-0 ``torch.save`` path
+  (io_ops.py:551-623).  Works across topology changes.
+- ``sharded``: every host writes its shards via orbax/tensorstore — the
+  reference's DeepSpeed sharded path (io_ops.py:389-483), but
+  restorable onto any topology because shardings are re-applied from the
+  *target* state at load time (the FSDP shard-extraction of the reference,
+  io_ops.py:298-306, is subsumed by "load into the declared shardings").
+
+The payload schema mirrors the reference exactly (io_ops.py:224-236):
+counters {backward_step, grad_accum_step, optimizer_step}, the status dict,
+model/optimizer/scaler state, and user extras.  Tag scheme:
+``stoke-{name}-backward-step-{n}`` (reference io_ops.py:49-87).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import re
+import shutil
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from stoke_tpu.configs import CheckpointConfig, CheckpointFormat
+from stoke_tpu.utils.printing import make_folder, unrolled_print
+
+_TAG_RE = re.compile(r"^stoke-(?P<name>.+)-backward-step-(?P<step>\d+)$")
+
+
+def checkpoint_tag(name: str, backward_step: int) -> str:
+    """Reference tag scheme ``stoke-{name}-backward-step-{n}.pt``
+    (io_ops.py:49-87); here a directory."""
+    return f"stoke-{name}-backward-step-{backward_step}"
+
+
+def _is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def _gather_to_host(tree: Any) -> Any:
+    """Device pytree → host numpy pytree, gathering shards across hosts when
+    needed (the consolidation step the reference implements per-backend,
+    io_ops.py:569-600)."""
+    if _is_multiprocess():
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(tree, tiled=True)
+    return jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+
+def _flat_arrays(tree: Any):
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return leaves, treedef
+
+
+def _save_consolidated(tag_dir: str, state: Dict[str, Any]) -> None:
+    """One ``.npz`` per state tree, leaves in flatten order (restore relies on
+    the target structure, so no treedef serialization is needed).  Multi-host:
+    every process gathers (a collective), only process 0 writes."""
+    for key, tree in state.items():
+        host = _gather_to_host(tree)
+        if jax.process_index() != 0:
+            continue
+        leaves, _ = _flat_arrays(host)
+        np.savez(
+            os.path.join(tag_dir, f"{key}.npz"),
+            **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)},
+        )
+
+
+def _load_consolidated(tag_dir: str, key: str, like: Any) -> Any:
+    with np.load(os.path.join(tag_dir, f"{key}.npz")) as data:
+        leaves_like, treedef = _flat_arrays(like)
+        n = len(data.files)
+        if n != len(leaves_like):
+            raise ValueError(
+                f"Stoke -- checkpoint {key} has {n} leaves; current state has "
+                f"{len(leaves_like)} (model/optimizer structure changed?)"
+            )
+        loaded = [data[f"leaf_{i}"] for i in range(n)]
+    placed = []
+    for arr, ref in zip(loaded, leaves_like):
+        if hasattr(ref, "sharding"):
+            placed.append(jax.device_put(arr.astype(ref.dtype), ref.sharding))
+        else:
+            placed.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, placed)
+
+
+def _orbax_checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def _save_sharded(tag_dir: str, state: Dict[str, Any]) -> None:
+    ckpt = _orbax_checkpointer()
+    for key, tree in state.items():
+        ckpt.save(os.path.join(tag_dir, f"{key}.orbax"), tree)
+    ckpt.wait_until_finished()
+
+
+def _load_sharded(tag_dir: str, key: str, like: Any) -> Any:
+    ckpt = _orbax_checkpointer()
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        if hasattr(x, "sharding")
+        else x,
+        like,
+    )
+    return ckpt.restore(os.path.join(tag_dir, f"{key}.orbax"), abstract)
+
+
+def save_checkpoint(
+    path: str,
+    name: str,
+    variables: Any,
+    opt_state: Any,
+    scaler_state: Any,
+    counters: Dict[str, int],
+    status: Dict[str, Any],
+    extras: Optional[Dict[str, Any]],
+    config: CheckpointConfig,
+    backward_step: int,
+    grad_buf: Any = None,
+) -> str:
+    """Write one logical checkpoint; returns the tag directory path.
+
+    Reference flow (io_ops.py:160-243 + per-backend wrappers :551-703):
+    barrier → gather/consolidate → write (rank 0 for consolidated, all ranks
+    for sharded) → barrier.  Metadata (counters/status/extras) is written by
+    process 0 only.  ``grad_buf`` (the partial accumulation window) is saved
+    too so a mid-window resume loses no gradient mass — the reference cannot
+    do this (torch ``.grad`` is not in ``state_dict``).
+    """
+    root = make_folder(path)
+    tag = checkpoint_tag(name, backward_step)
+    tag_dir = os.path.join(root, tag)
+    if jax.process_index() == 0:
+        os.makedirs(tag_dir, exist_ok=True)
+    _barrier()
+    state = {
+        "variables": variables,
+        "opt_state": opt_state,
+        "scaler_state": scaler_state,
+    }
+    if grad_buf is not None:
+        state["grad_buf"] = grad_buf
+    if config.format is CheckpointFormat.consolidated:
+        _save_consolidated(tag_dir, state)
+    else:
+        _save_sharded(tag_dir, state)
+    if jax.process_index() == 0:
+        meta = {
+            "format": config.format.value,
+            "counters": counters,
+            "status": status,
+            "name": name,
+        }
+        with open(os.path.join(tag_dir, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=2, default=str)
+        if extras:
+            with open(os.path.join(tag_dir, "extras.pkl"), "wb") as f:
+                pickle.dump(extras, f)
+        _prune_old(root, name, config.max_to_keep)
+        unrolled_print(f"Saved checkpoint {tag_dir}")
+    _barrier()
+    return tag_dir
+
+
+def _prune_old(root: str, name: str, max_to_keep: Optional[int]) -> None:
+    """Keep the newest N tags (by backward step) for this name."""
+    if not max_to_keep:
+        return
+    tags = []
+    for entry in os.listdir(root):
+        m = _TAG_RE.match(entry)
+        if m and m.group("name") == name:
+            tags.append((int(m.group("step")), entry))
+    tags.sort()
+    for _, entry in tags[:-max_to_keep]:
+        shutil.rmtree(os.path.join(root, entry), ignore_errors=True)
+
+
+def _latest_tag(root: str, name: Optional[str]) -> Optional[str]:
+    """Newest tag by backward step, scoped to ``name`` when given (so two
+    runs sharing a directory never load each other's state)."""
+    best = None
+    for entry in os.listdir(root):
+        m = _TAG_RE.match(entry)
+        if m and (name is None or m.group("name") == name):
+            step = int(m.group("step"))
+            if best is None or step > best[0]:
+                best = (step, entry)
+    return best[1] if best else None
+
+
+def _barrier() -> None:
+    if _is_multiprocess():
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("stoke_ckpt")
+
+
+def load_checkpoint(
+    path: str,
+    tag: Optional[str],
+    variables_like: Any,
+    opt_state_like: Any,
+    scaler_like: Any,
+    config: CheckpointConfig,
+    name: Optional[str] = None,
+    grad_buf_like: Any = None,
+) -> Dict[str, Any]:
+    """Load a checkpoint onto the CURRENT sharding layout.
+
+    ``tag=None`` loads the newest tag under ``path`` (scoped to ``name`` when
+    given).  The on-disk format is read from ``meta.json`` (a consolidated
+    checkpoint can be loaded by a sharded run and vice versa — the reference
+    cannot do this across backends; SURVEY.md §7 hard part #4).
+    """
+    root = os.path.abspath(os.path.expanduser(path))
+    if tag is None:
+        tag = _latest_tag(root, name)
+        if tag is None:
+            raise FileNotFoundError(f"Stoke -- no checkpoints found under {root}")
+    tag_dir = os.path.join(root, tag)
+    with open(os.path.join(tag_dir, "meta.json")) as f:
+        meta = json.load(f)
+    fmt = CheckpointFormat(meta["format"])
+    loader = _load_consolidated if fmt is CheckpointFormat.consolidated else _load_sharded
+    payload = {
+        "variables": loader(tag_dir, "variables", variables_like),
+        "opt_state": loader(tag_dir, "opt_state", opt_state_like),
+        "scaler_state": loader(tag_dir, "scaler_state", scaler_like),
+        "counters": meta["counters"],
+        "status": meta["status"],
+        "grad_buf": None,
+    }
+    has_buf = os.path.exists(
+        os.path.join(tag_dir, "grad_buf.npz")
+    ) or os.path.exists(os.path.join(tag_dir, "grad_buf.orbax"))
+    if grad_buf_like is not None and has_buf:
+        payload["grad_buf"] = loader(tag_dir, "grad_buf", grad_buf_like)
+    extras_path = os.path.join(tag_dir, "extras.pkl")
+    if os.path.exists(extras_path):
+        with open(extras_path, "rb") as f:
+            payload["extras"] = pickle.load(f)
+    unrolled_print(f"Loaded checkpoint {tag_dir}")
+    return payload

@@ -1,0 +1,26 @@
+"""Model library for examples/benchmarks.
+
+The reference ships models only as example code (vendored torchvision ResNet,
+examples/cifar10/model.py:19-293, and a BasicNN in README.md:100-102); here
+they are first-class flax modules used by the examples, the benchmark, and
+the driver entry point."""
+
+from stoke_tpu.models.basic import BasicNN
+from stoke_tpu.models.resnet import (
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
+
+__all__ = [
+    "BasicNN",
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+    "ResNet152",
+]

@@ -1,0 +1,28 @@
+"""BasicNN: the small CIFAR-10 CNN of the reference quick-start
+(README.md:100-115 — two conv+pool blocks, three dense layers)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicNN(nn.Module):
+    """Reference quick-start CNN (README.md:100-115): conv(6,5x5) → pool →
+    conv(16,5x5) → pool → fc120 → fc84 → fc(num_classes).  NHWC layout
+    (TPU-native; the reference's NCHW is a CUDA idiom)."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(6, (5, 5), padding="VALID")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(16, (5, 5), padding="VALID")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(120)(x))
+        x = nn.relu(nn.Dense(84)(x))
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,133 @@
+"""ResNet family (v1.5) in flax, TPU-native.
+
+Replaces the reference's vendored torchvision ResNet
+(examples/cifar10/model.py:19-293) with an idiomatic flax implementation:
+
+- NHWC layout + channels-last convs (MXU-friendly; torch's NCHW is a CUDA
+  idiom).
+- BatchNorm via ``nn.BatchNorm`` with a ``batch_stats`` collection.  Under
+  jit-GSPMD over a global batch the batch moments are computed over the
+  LOGICALLY-GLOBAL batch, so cross-replica SyncBatchNorm (which the reference
+  must convert to explicitly, distributed.py:575-579, :1318-1371) is the
+  default behavior here.
+- ``cifar_stem=True`` swaps the 7x7/stride-2+maxpool ImageNet stem for the
+  3x3/stride-1 CIFAR stem (standard for 32x32 inputs).
+
+Supports 18/34 (basic block) and 50/101/152 (bottleneck).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides, name="conv_proj")(
+                residual
+            )
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        # zero-init the last BN scale so each block starts as identity
+        # (standard ResNet v1.5 training recipe)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), self.strides, name="conv_proj"
+            )(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """Configurable ResNet v1.5.
+
+    Args:
+        stage_sizes: blocks per stage, e.g. (3, 4, 6, 3) for ResNet-50.
+        block: BasicBlock or BottleneckBlock.
+        num_classes: classifier width.
+        num_filters: stem width (64 for the standard family).
+        cifar_stem: 3x3/s1 stem without maxpool (for 32x32 inputs).
+        dtype: compute dtype of the module's intermediate activations; the
+            framework's precision policy casts inputs/params, so the default
+            float32 here means "inherit whatever comes in".
+    """
+
+    stage_sizes: Sequence[int]
+    block: Callable
+    num_classes: int = 1000
+    num_filters: int = 64
+    cifar_stem: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+        )
+        if self.cifar_stem:
+            x = conv(self.num_filters, (3, 3), name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        x = norm(name="norm_init")(x)
+        x = nn.relu(x)
+        if not self.cifar_stem:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for b in range(n_blocks):
+                strides = (2, 2) if stage > 0 and b == 0 else (1, 1)
+                x = self.block(
+                    filters=self.num_filters * 2**stage,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block=BasicBlock)
+ResNet34 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block=BottleneckBlock)
+ResNet101 = partial(ResNet, stage_sizes=(3, 4, 23, 3), block=BottleneckBlock)
+ResNet152 = partial(ResNet, stage_sizes=(3, 8, 36, 3), block=BottleneckBlock)

@@ -1,0 +1,24 @@
+"""SPMD parallelism layer: mesh construction, multi-host rendezvous, and the
+sharding-tier rules that replace the reference's per-backend process wrappers
+(DDP/Horovod/DeepSpeed + fairscale OSS/SDDP/FSDP) with one engine
+(SURVEY.md §2.9, §7)."""
+
+from stoke_tpu.parallel.mesh import build_mesh, initialize_distributed, local_device_count
+from stoke_tpu.parallel.sharding import (
+    ShardingRules,
+    batch_sharding,
+    leaf_partition_spec,
+    make_sharding_rules,
+    sharding_tree,
+)
+
+__all__ = [
+    "build_mesh",
+    "initialize_distributed",
+    "local_device_count",
+    "ShardingRules",
+    "batch_sharding",
+    "leaf_partition_spec",
+    "make_sharding_rules",
+    "sharding_tree",
+]

@@ -1,0 +1,174 @@
+"""Sharding rules: the ZeRO-1/2/3 ladder as NamedSharding presets.
+
+This module is the TPU-native replacement for the reference's sharding
+extensions (stoke/extensions.py:81-376 — fairscale OSS, ShardedDataParallel,
+FullyShardedDataParallel).  Where fairscale hand-implements broadcast /
+reduce-scatter / all-gather schedules in CUDA streams, here each tier is just
+a *placement rule* — which pytrees (params / grads / optimizer state) are
+sharded over the mesh ``data`` axis — and XLA's GSPMD pass derives the
+collectives (arxiv 2004.13336 "Automatic Cross-Replica Sharding of Weight
+Update"; SURVEY.md §7):
+
+- tier none (plain DP, reference extensions.py:151-216):
+    params/grads/opt replicated; XLA all-reduces grads.
+- tier oss  (ZeRO-1, reference extensions.py:81-141):
+    optimizer state sharded → weight-update sharding; XLA turns the grad
+    all-reduce into reduce-scatter + all-gather of updated params.
+- tier sddp (ZeRO-2, reference extensions.py:219-286):
+    + gradient accumulator sharded → the combine is a true reduce-scatter and
+    the fp32 grad buffer takes 1/N memory.
+- tier fsdp (ZeRO-3, reference extensions.py:289-376):
+    + parameters sharded → all-gather before use, scheduled by XLA.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from stoke_tpu.configs import FSDPConfig, OSSConfig, SDDPConfig, ShardingOptions
+
+
+def leaf_partition_spec(
+    shape: tuple,
+    axis_name: str,
+    axis_size: int,
+    min_size: int = 0,
+    preference: str = "largest",
+) -> P:
+    """Choose the PartitionSpec for one array: shard one dimension over the
+    data axis if profitable, else replicate.
+
+    Mirrors the role of fairscale's parameter flatten-and-chunk (FSDP
+    ``flatten_parameters``, reference configs.py:672) without the flattening:
+    XLA shards at array granularity, so we pick the dimension — the largest
+    one divisible by the axis size ("largest", default) or dim 0 when
+    divisible ("first").  Arrays smaller than ``min_size`` elements stay
+    replicated (collective latency beats memory savings; reference FSDP-style
+    min-param bucketing).
+    """
+    if axis_size <= 1 or not shape:
+        return P()
+    if int(np.prod(shape)) < max(min_size, axis_size):
+        return P()
+    dims = range(len(shape))
+    if preference == "first":
+        # dim 0 when divisible, else replicate (documented semantics)
+        pick = 0 if shape[0] % axis_size == 0 else None
+    else:
+        divisible = [d for d in dims if shape[d] % axis_size == 0]
+        pick = max(divisible, key=lambda d: shape[d], default=None)
+    if pick is None:
+        return P()
+    spec = [None] * len(shape)
+    spec[pick] = axis_name
+    return P(*spec)
+
+
+def sharding_tree(
+    tree_shapes: Any,
+    mesh: Mesh,
+    spec_fn: Callable[[tuple], P],
+) -> Any:
+    """Map a pytree of arrays/ShapeDtypeStructs to a pytree of NamedShardings."""
+
+    def _one(leaf):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        return NamedSharding(mesh, spec_fn(shape))
+
+    return jax.tree_util.tree_map(_one, tree_shapes)
+
+
+def batch_sharding(mesh: Optional[Mesh], axis_name: str = "data"):
+    """NamedSharding placing the global batch split over the data axis
+    (replaces per-rank DataLoader slices + ``place_data_on_gpu``,
+    reference stoke/utils.py:39-80; SURVEY.md §3.3)."""
+    if mesh is None:
+        return None
+
+    def _spec(shape):
+        if not shape:
+            return P()
+        if shape[0] % mesh.shape[axis_name] != 0:
+            return P()
+        return P(axis_name)
+
+    class _BatchShardingFactory:
+        def for_leaf(self, shape):
+            return NamedSharding(mesh, _spec(tuple(shape)))
+
+    return _BatchShardingFactory()
+
+
+@dataclass
+class ShardingRules:
+    """Placement rules for one run: which state pytrees shard over ``data``.
+
+    ``None`` spec-fn means "replicated everywhere".  Built once by
+    :func:`make_sharding_rules` from the validated status flags and consumed
+    by the engine when it pins ``in_shardings``/``out_shardings`` on the
+    compiled steps.
+    """
+
+    mesh: Optional[Mesh]
+    axis_name: str
+    param_spec: Callable[[tuple], P]
+    grad_spec: Callable[[tuple], P]
+    opt_spec: Callable[[tuple], P]
+
+    def param_shardings(self, tree_shapes):
+        return sharding_tree(tree_shapes, self.mesh, self.param_spec)
+
+    def grad_shardings(self, tree_shapes):
+        return sharding_tree(tree_shapes, self.mesh, self.grad_spec)
+
+    def opt_shardings(self, tree_shapes):
+        return sharding_tree(tree_shapes, self.mesh, self.opt_spec)
+
+    def replicated(self):
+        return NamedSharding(self.mesh, P())
+
+
+def make_sharding_rules(
+    tier: ShardingOptions,
+    mesh: Optional[Mesh],
+    axis_name: str,
+    oss_config: OSSConfig,
+    sddp_config: SDDPConfig,
+    fsdp_config: FSDPConfig,
+) -> Optional[ShardingRules]:
+    """Build the tier's placement rules (the ladder table in the module
+    docstring).  Returns None when there is no mesh (single-device)."""
+    if mesh is None:
+        return None
+    size = mesh.shape[axis_name]
+    repl: Callable[[tuple], P] = lambda shape: P()
+    shard_opt = lambda shape: leaf_partition_spec(
+        shape, axis_name, size, oss_config.min_shard_size, "largest"
+    )
+    shard_grad = lambda shape: leaf_partition_spec(
+        shape, axis_name, size, sddp_config.min_shard_size, "largest"
+    )
+    shard_param = lambda shape: leaf_partition_spec(
+        shape,
+        axis_name,
+        size,
+        fsdp_config.min_weight_size,
+        fsdp_config.shard_axis_preference,
+    )
+    if tier is ShardingOptions.none:
+        return ShardingRules(mesh, axis_name, repl, repl, repl)
+    if tier is ShardingOptions.oss:
+        return ShardingRules(mesh, axis_name, repl, repl, shard_opt)
+    if tier is ShardingOptions.sddp:
+        return ShardingRules(mesh, axis_name, repl, shard_grad, shard_opt)
+    if tier is ShardingOptions.fsdp:
+        # FSDP: params/grads/opt all follow the *param* placement so the
+        # update is fully local (reference FSDP shards the flat param and
+        # derives grad/opt shards from it, extensions.py:289-376).
+        return ShardingRules(mesh, axis_name, shard_param, shard_param, shard_param)
+    raise ValueError(f"unknown sharding tier {tier}")

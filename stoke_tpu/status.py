@@ -1,0 +1,401 @@
+"""State & validation layer: `StokeStatus`.
+
+TPU-native re-design of the reference status layer (stoke/status.py:54-654):
+a single source of truth for the run configuration that
+
+1. deduplicates user-supplied config objects by class (reference
+   ``_set_configs``, status.py:321-343),
+2. enforces the legal-combination matrix *before* any device work happens
+   (reference ``_check_all_raised_combinations``, status.py:192-289 — the
+   README compatibility table), and
+3. lazily materializes per-concern default configs via properties
+   (reference status.py:473-627).
+
+The combination matrix is table-driven (a list of rule functions) so tests can
+enumerate it exhaustively — SURVEY.md §4 calls this "a table-driven test
+goldmine".
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+from stoke_tpu.configs import (
+    ALL_CONFIG_CLASSES,
+    ActivationCheckpointingConfig,
+    CheckpointConfig,
+    ClipGradConfig,
+    ClipGradNormConfig,
+    DataParallelConfig,
+    DeviceOptions,
+    DistributedInitConfig,
+    DistributedOptions,
+    FSDPConfig,
+    MeshConfig,
+    OSSConfig,
+    PrecisionConfig,
+    PrecisionOptions,
+    ProfilerConfig,
+    SDDPConfig,
+    ShardingOptions,
+    asdict_config,
+)
+
+
+class StokeValidationError(ValueError):
+    """Raised when constructor flags form an illegal combination
+    (reference raises bare ValueError from status.py:192-289)."""
+
+
+# Aliases accepted for reference-API compatibility: users of the reference
+# select among {ddp, horovod, deepspeed} (status.py:31-38); on TPU these are
+# all the one SPMD data-parallel engine.
+_DISTRIBUTED_ALIASES = {
+    "ddp": DistributedOptions.dp,
+    "horovod": DistributedOptions.dp,
+    "deepspeed": DistributedOptions.dp,
+    "dp": DistributedOptions.dp,
+    "xla": DistributedOptions.dp,
+}
+
+# Reference FP16Options {apex_O1, apex_O2, amp, deepspeed} (status.py:40-45)
+# all meant "fp16 with a loss scaler" on GPU; on TPU the native answer is bf16.
+_PRECISION_ALIASES = {
+    "full": PrecisionOptions.full,
+    "fp32": PrecisionOptions.full,
+    "bf16": PrecisionOptions.bf16,
+    "bfloat16": PrecisionOptions.bf16,
+    "fp16": PrecisionOptions.fp16,
+    "float16": PrecisionOptions.fp16,
+    "amp": PrecisionOptions.bf16,
+    "apex_O1": PrecisionOptions.bf16,
+    "apex_O2": PrecisionOptions.bf16,
+    "deepspeed": PrecisionOptions.bf16,
+}
+
+
+def _coerce(value, enum_cls, aliases, what):
+    if value is None:
+        return None
+    if isinstance(value, enum_cls):
+        return value
+    if isinstance(value, str):
+        if value in aliases:
+            return aliases[value]
+        try:
+            return enum_cls(value)
+        except ValueError:
+            pass
+    raise StokeValidationError(
+        f"Unknown {what} option {value!r}; valid: "
+        f"{sorted({*aliases, *[e.value for e in enum_cls]})}"
+    )
+
+
+class StokeStatus:
+    """Single source of truth for the run configuration.
+
+    Mirrors reference ``StokeStatus`` (status.py:54-654): holds the canonical
+    status dict, validates flag combinations, and materializes per-concern
+    default configs lazily.
+
+    Args:
+        batch_size_per_device: micro-batch size per device (reference
+            ``batch_size`` is per-process; on TPU one process feeds all local
+            devices so per-device is the invariant unit).
+        grad_accum: gradient accumulation steps (reference stoke.py:137).
+        grad_clip: ClipGradConfig | ClipGradNormConfig | None (stoke.py:139).
+        device: "cpu" | "tpu" (reference ``gpu: bool``, stoke.py:141).
+        distributed: None | "dp" (+ reference aliases ddp/horovod/deepspeed).
+        precision: None/"full" | "bf16" | "fp16" (+ reference FP16 aliases).
+        oss / sddp / fsdp: the sharding-tier ladder (reference
+            fairscale_oss/sddp/fsdp flags, stoke.py:147-152).
+        configs: optional list of config-class instances, deduped by class
+            (reference status.py:321-343).
+    """
+
+    def __init__(
+        self,
+        batch_size_per_device: int,
+        grad_accum: Optional[int] = None,
+        grad_clip: Optional[Union[ClipGradConfig, ClipGradNormConfig]] = None,
+        device: Union[str, DeviceOptions] = DeviceOptions.cpu,
+        distributed: Optional[Union[str, DistributedOptions]] = None,
+        precision: Optional[Union[str, PrecisionOptions]] = None,
+        oss: bool = False,
+        sddp: bool = False,
+        fsdp: bool = False,
+        configs: Optional[Sequence[Any]] = None,
+    ):
+        self._configs = self._set_configs(configs)
+        self._status: Dict[str, Any] = {
+            "batch_size_per_device": batch_size_per_device,
+            "grad_accum": 1 if grad_accum is None else int(grad_accum),
+            "grad_clip": grad_clip,
+            "device": _coerce(device, DeviceOptions, {}, "device"),
+            "distributed": _coerce(
+                distributed, DistributedOptions, _DISTRIBUTED_ALIASES, "distributed"
+            ),
+            "precision": _coerce(
+                precision, PrecisionOptions, _PRECISION_ALIASES, "precision"
+            )
+            or PrecisionOptions.full,
+            "oss": bool(oss),
+            "sddp": bool(sddp),
+            "fsdp": bool(fsdp),
+            # filled in post-init (reference set_post_init_values, status.py:345)
+            "world_size": None,
+            "n_devices": None,
+            "n_processes": None,
+            "effective_batch_size": None,
+        }
+        self._check_all_raised_combinations()
+
+    # ------------------------------------------------------------------ #
+    # Config dedupe (reference status.py:321-343)
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def _set_configs(configs: Optional[Sequence[Any]]) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for cfg in configs or ():
+            name = type(cfg).__name__
+            if not isinstance(cfg, ALL_CONFIG_CLASSES):
+                raise StokeValidationError(
+                    f"Unrecognized config object of type {name}; expected one of "
+                    f"{[c.__name__ for c in ALL_CONFIG_CLASSES]}"
+                )
+            if name in out:
+                warnings.warn(
+                    f"Stoke -- Duplicate config {name} supplied; keeping the "
+                    f"last one (mirrors reference status.py:321-343)"
+                )
+            out[name] = cfg
+        return out
+
+    # ------------------------------------------------------------------ #
+    # The legal-combination matrix (reference status.py:192-289)
+    # ------------------------------------------------------------------ #
+
+    def _rules(self) -> List[Tuple[Callable[[Dict[str, Any]], bool], str]]:
+        """Table of (predicate, message).  A predicate returning True means the
+        combination is ILLEGAL.  Table-driven so tests enumerate it."""
+        return [
+            (
+                lambda s: s["batch_size_per_device"] is None
+                or s["batch_size_per_device"] < 1,
+                "batch_size_per_device must be >= 1",
+            ),
+            (
+                lambda s: s["grad_accum"] < 1,
+                "grad_accum must be >= 1",
+            ),
+            (
+                lambda s: s["grad_clip"] is not None
+                and not isinstance(s["grad_clip"], (ClipGradConfig, ClipGradNormConfig)),
+                "grad_clip must be ClipGradConfig, ClipGradNormConfig, or None",
+            ),
+            # sharding ladder legality (reference status.py:239-263):
+            # SDDP requires OSS (status.py:240-243)
+            (
+                lambda s: s["sddp"] and not s["oss"],
+                "sddp (gradient sharding) requires oss (optimizer-state "
+                "sharding) — reference status.py:240-243",
+            ),
+            # FSDP subsumes and excludes OSS/SDDP (reference status.py:244-263)
+            (
+                lambda s: s["fsdp"] and (s["oss"] or s["sddp"]),
+                "fsdp (fully-sharded) already shards optimizer state and "
+                "gradients; combining with oss/sddp is illegal — reference "
+                "status.py:244-263",
+            ),
+            # sharding requires the distributed engine (reference: fairscale
+            # extensions require DDP, status.py:231-263)
+            (
+                lambda s: (s["oss"] or s["sddp"] or s["fsdp"])
+                and s["distributed"] is None,
+                "sharding tiers (oss/sddp/fsdp) require distributed='dp' — "
+                "reference status.py:231-263",
+            ),
+        ]
+
+    def _check_all_raised_combinations(self) -> None:
+        for predicate, message in self._rules():
+            if predicate(self._status):
+                raise StokeValidationError(f"Stoke -- illegal combination: {message}")
+
+    # ------------------------------------------------------------------ #
+    # Post-init values (reference status.py:345-372, effective batch :373-375)
+    # ------------------------------------------------------------------ #
+
+    def set_post_init_values(
+        self, world_size: int, n_processes: int = 1
+    ) -> None:
+        """Record device/process topology once the engine exists (reference
+        ``set_post_init_values``, status.py:345; effective batch size calc
+        status.py:373-375)."""
+        self._status["world_size"] = world_size
+        self._status["n_devices"] = world_size
+        self._status["n_processes"] = n_processes
+        self._status["effective_batch_size"] = (
+            self._status["batch_size_per_device"]
+            * world_size
+            * self._status["grad_accum"]
+        )
+
+    # ------------------------------------------------------------------ #
+    # Flag accessors
+    # ------------------------------------------------------------------ #
+
+    @property
+    def status(self) -> Dict[str, Any]:
+        """Canonical status dict (reference status.py:171-188)."""
+        return dict(self._status)
+
+    @property
+    def batch_size(self) -> int:
+        return self._status["batch_size_per_device"]
+
+    @property
+    def effective_batch_size(self) -> Optional[int]:
+        return self._status["effective_batch_size"]
+
+    @property
+    def grad_accum(self) -> int:
+        return self._status["grad_accum"]
+
+    @property
+    def grad_clip(self):
+        return self._status["grad_clip"]
+
+    @property
+    def device(self) -> DeviceOptions:
+        return self._status["device"]
+
+    @property
+    def is_tpu(self) -> bool:
+        return self._status["device"] is DeviceOptions.tpu
+
+    @property
+    def distributed(self) -> Optional[DistributedOptions]:
+        return self._status["distributed"]
+
+    @property
+    def is_distributed(self) -> bool:
+        return self._status["distributed"] is not None
+
+    @property
+    def precision(self) -> PrecisionOptions:
+        return self._status["precision"]
+
+    @property
+    def is_scaled_precision(self) -> bool:
+        """True when a dynamic loss scaler is in play (fp16 only; bf16 needs
+        none — SURVEY.md §3.2 hot-loop observation (c))."""
+        return self._status["precision"] is PrecisionOptions.fp16
+
+    @property
+    def oss(self) -> bool:
+        return self._status["oss"]
+
+    @property
+    def sddp(self) -> bool:
+        return self._status["sddp"]
+
+    @property
+    def fsdp(self) -> bool:
+        return self._status["fsdp"]
+
+    @property
+    def sharding_tier(self) -> ShardingOptions:
+        """Collapse the three booleans to the ladder rung (post-validation the
+        combinations are mutually consistent)."""
+        if self._status["fsdp"]:
+            return ShardingOptions.fsdp
+        if self._status["sddp"]:
+            return ShardingOptions.sddp
+        if self._status["oss"]:
+            return ShardingOptions.oss
+        return ShardingOptions.none
+
+    @property
+    def world_size(self) -> Optional[int]:
+        return self._status["world_size"]
+
+    # ------------------------------------------------------------------ #
+    # Lazily-materialized per-concern configs (reference status.py:473-627)
+    # ------------------------------------------------------------------ #
+
+    def _get_or_default(self, cls):
+        name = cls.__name__
+        if name not in self._configs:
+            self._configs[name] = cls()
+        return self._configs[name]
+
+    @property
+    def precision_config(self) -> PrecisionConfig:
+        return self._get_or_default(PrecisionConfig)
+
+    @property
+    def dp_config(self) -> DataParallelConfig:
+        return self._get_or_default(DataParallelConfig)
+
+    @property
+    def mesh_config(self) -> MeshConfig:
+        return self._get_or_default(MeshConfig)
+
+    @property
+    def dist_init_config(self) -> DistributedInitConfig:
+        return self._get_or_default(DistributedInitConfig)
+
+    @property
+    def oss_config(self) -> OSSConfig:
+        return self._get_or_default(OSSConfig)
+
+    @property
+    def sddp_config(self) -> SDDPConfig:
+        return self._get_or_default(SDDPConfig)
+
+    @property
+    def fsdp_config(self) -> FSDPConfig:
+        return self._get_or_default(FSDPConfig)
+
+    @property
+    def activation_checkpointing_config(self) -> Optional[ActivationCheckpointingConfig]:
+        """None unless explicitly supplied (remat is opt-in, matching the
+        reference where activation checkpointing is DeepSpeed-only
+        passthrough, configs.py:222-248)."""
+        return self._configs.get("ActivationCheckpointingConfig")
+
+    @property
+    def checkpoint_config(self) -> CheckpointConfig:
+        return self._get_or_default(CheckpointConfig)
+
+    @property
+    def profiler_config(self) -> ProfilerConfig:
+        return self._get_or_default(ProfilerConfig)
+
+    # ------------------------------------------------------------------ #
+    # Serialization / display (reference status.py:629-654)
+    # ------------------------------------------------------------------ #
+
+    def to_dict(self) -> Dict[str, Any]:
+        """JSON-friendly dump for checkpoints (reference saves the status dict
+        inside every checkpoint, io_ops.py:224-236)."""
+        out = {}
+        for k, v in self._status.items():
+            if hasattr(v, "value") and not isinstance(v, (int, float, str)):
+                v = v.value
+            elif isinstance(v, (ClipGradConfig, ClipGradNormConfig)):
+                v = {"type": type(v).__name__, **asdict_config(v)}
+            out[k] = v
+        out["configs"] = {k: asdict_config(v) for k, v in self._configs.items()}
+        return out
+
+    def __repr__(self) -> str:  # reference status.py:629-654
+        lines = ["Stoke -- Status:"]
+        for k, v in self.to_dict().items():
+            lines.append(f"  {k}: {v}")
+        return "\n".join(lines)

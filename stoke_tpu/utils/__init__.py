@@ -1,0 +1,26 @@
+"""Utility helpers (reference: stoke/utils.py:1-151, TPU-native re-design)."""
+
+from stoke_tpu.utils.printing import unrolled_print, make_folder
+from stoke_tpu.utils.trees import (
+    tree_count_params,
+    tree_cast,
+    tree_zeros_like,
+    tree_add,
+    tree_scale,
+    tree_finite,
+    place_data_on_device,
+    to_numpy_tree,
+)
+
+__all__ = [
+    "unrolled_print",
+    "make_folder",
+    "tree_count_params",
+    "tree_cast",
+    "tree_zeros_like",
+    "tree_add",
+    "tree_scale",
+    "tree_finite",
+    "place_data_on_device",
+    "to_numpy_tree",
+]

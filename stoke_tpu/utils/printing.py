@@ -1,0 +1,28 @@
+"""Printing / filesystem helpers (reference: stoke/utils.py:109-151)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Iterable, Union
+
+
+def unrolled_print(value: Union[str, Iterable[Any]], single_line: bool = False) -> None:
+    """Print strings or iterables of strings with the ``Stoke --`` prefix
+    (reference ``unrolled_print``, stoke/utils.py:109-134)."""
+    if isinstance(value, str):
+        print(f"Stoke -- {value}")
+        return
+    items = list(value)
+    if single_line:
+        print("Stoke -- " + ", ".join(str(v) for v in items))
+    else:
+        for v in items:
+            print(f"Stoke -- {v}")
+
+
+def make_folder(path: str) -> str:
+    """Create a directory if needed, returning the absolute path
+    (reference ``make_folder``, stoke/utils.py:137-151)."""
+    path = os.path.abspath(os.path.expanduser(path))
+    os.makedirs(path, exist_ok=True)
+    return path

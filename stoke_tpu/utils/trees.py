@@ -1,0 +1,100 @@
+"""Pytree helpers: the TPU equivalents of the reference's tensor plumbing.
+
+Reference counterparts:
+- ``place_data_on_gpu`` recursive tensor mover (stoke/utils.py:39-80) →
+  :func:`place_data_on_device` (host batch → device/sharded jax arrays).
+- ``zero_optimizer_grads`` (stoke/utils.py:83-106) → grads live in an explicit
+  accumulation pytree; "zeroing" is :func:`tree_zeros_like` inside the compiled
+  apply step (no eager ``.grad`` attributes to clear).
+- parameter counting for ``num_model_parameters`` (stoke/stoke.py:1144-1162) →
+  :func:`tree_count_params`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def tree_count_params(tree: Any) -> int:
+    """Total number of elements across all leaves (reference param-count
+    helper, stoke.py:1144-1162)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return int(sum(np.prod(l.shape) if hasattr(l, "shape") else 1 for l in leaves))
+
+
+def tree_cast(tree: Any, dtype) -> Any:
+    """Cast all inexact (floating) leaves to ``dtype``; leave integer/bool
+    leaves untouched (the bf16 compute-policy cast, SURVEY.md §7)."""
+    if dtype is None:
+        return tree
+
+    def _cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(_cast, tree)
+
+
+def tree_zeros_like(tree: Any) -> Any:
+    return jax.tree_util.tree_map(jnp.zeros_like, tree)
+
+
+def tree_add(a: Any, b: Any) -> Any:
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def tree_scale(tree: Any, scalar) -> Any:
+    return jax.tree_util.tree_map(lambda x: x * scalar, tree)
+
+
+def tree_finite(tree: Any):
+    """Scalar bool: True iff every element of every leaf is finite (the
+    functional replacement for GradScaler's inf/nan found check,
+    reference fp16.py:788-806)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(True)
+    finites = [jnp.all(jnp.isfinite(l)) for l in leaves]
+    return jnp.stack(finites).all()
+
+
+def _to_host_array(x: Any) -> Any:
+    """Torch tensor / list / scalar → numpy (host side, zero-copy for torch
+    CPU tensors)."""
+    if hasattr(x, "detach") and hasattr(x, "numpy"):  # torch.Tensor, no import
+        return x.detach().cpu().numpy()
+    if isinstance(x, np.ndarray):
+        return x
+    return np.asarray(x)
+
+
+def place_data_on_device(batch: Any, sharding: Optional[Any] = None) -> Any:
+    """Recursively move a host batch (torch tensors / numpy / nested
+    list/tuple/dict) onto device, optionally with a NamedSharding so the global
+    batch lands sharded over the mesh data axis.
+
+    TPU-native replacement for ``place_data_on_gpu`` (stoke/utils.py:39-80):
+    instead of per-rank ``.cuda()`` calls, one host process places its slice of
+    the logically-global batch and XLA addresses it via the sharding.
+    """
+
+    def _place(x):
+        arr = _to_host_array(x)
+        if sharding is not None:
+            return jax.device_put(arr, sharding)
+        return jax.device_put(arr)
+
+    return jax.tree_util.tree_map(
+        _place, batch, is_leaf=lambda x: hasattr(x, "detach") or hasattr(x, "shape")
+    )
+
+
+def to_numpy_tree(tree: Any) -> Any:
+    """Device pytree → host numpy pytree (checkpoint consolidation path,
+    reference io_ops.py:160-243 state_dict gather)."""
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)

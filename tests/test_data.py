@@ -1,0 +1,158 @@
+"""Data layer tests: BucketedDistributedSampler invariants (the index math of
+reference data.py:380-498, property-tested per SURVEY.md §7 hard part #5) and
+StokeDataLoader placement."""
+
+import itertools
+
+import jax
+import numpy as np
+import pytest
+
+from stoke_tpu.data import BucketedDistributedSampler, StokeDataLoader
+
+
+class SizedDataset:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.float32([i, i + 0.5])
+
+
+def make_sampler(n=1000, buckets=4, batch=8, replicas=2, rank=0, **kw):
+    return BucketedDistributedSampler(
+        SizedDataset(n),
+        buckets=buckets,
+        batch_size=batch,
+        sorted_idx=list(range(n)),
+        num_replicas=replicas,
+        rank=rank,
+        **kw,
+    )
+
+
+def test_len_matches_iteration():
+    s = make_sampler()
+    idx = list(iter(s))
+    assert len(idx) == len(s)  # invariant at reference data.py:447
+
+
+def test_all_replicas_cover_slices_disjointly():
+    """Within an epoch, replicas' index sets are disjoint and equal-sized."""
+    per_rank = []
+    for r in range(2):
+        s = make_sampler(rank=r, shuffle=False, drop_last=True)
+        per_rank.append(list(iter(s)))
+    assert len(per_rank[0]) == len(per_rank[1])
+    assert set(per_rank[0]).isdisjoint(set(per_rank[1]))
+
+
+def test_batches_stay_within_buckets():
+    """Every per-replica batch must draw from ONE bucket (the whole point:
+    similar-length samples batch together)."""
+    n, buckets, batch = 1024, 4, 8
+    s = make_sampler(n=n, buckets=buckets, batch=batch, replicas=2, rank=0, drop_last=True)
+    idx = list(iter(s))
+    bucket_of = lambda i: i * buckets // n  # sorted_idx == range → contiguous buckets
+    for b in range(0, len(idx), batch):
+        bs = {bucket_of(i) for i in idx[b : b + batch]}
+        assert len(bs) == 1, f"batch {b // batch} mixes buckets {bs}"
+
+
+def test_padding_short_buckets():
+    """n chosen so buckets don't divide evenly: short final slices must be
+    padded to full batch size (reference data.py:450-498)."""
+    s = make_sampler(n=1010, buckets=3, batch=8, replicas=2, shuffle=True)
+    idx = list(iter(s))
+    assert len(idx) == len(s)
+    assert len(idx) % 8 == 0  # whole batches only
+
+
+def test_epoch_reshuffle_deterministic():
+    s = make_sampler(shuffle=True, seed=11)
+    s.set_epoch(0)
+    a0 = list(iter(s))
+    s.set_epoch(0)
+    assert list(iter(s)) == a0  # same epoch → same order
+    s.set_epoch(1)
+    a1 = list(iter(s))
+    assert a1 != a0  # new epoch → reshuffled
+    assert sorted(set(a1)) == sorted(set(a1))
+
+
+def test_replicas_agree_on_slices():
+    """The union of all replicas' strided sub-batches per slice must be the
+    slice itself: checked by summing coverage across replicas."""
+    replicas = 4
+    all_idx = []
+    for r in range(replicas):
+        s = make_sampler(n=1600, buckets=2, batch=4, replicas=replicas, rank=r,
+                         shuffle=True, seed=3, drop_last=True)
+        all_idx.append(list(iter(s)))
+    lengths = {len(a) for a in all_idx}
+    assert len(lengths) == 1
+    combined = list(itertools.chain(*all_idx))
+    # with drop_last each kept index appears exactly once across replicas
+    assert len(combined) == len(set(combined))
+
+
+def test_bucket_overlap_residuals():
+    base = make_sampler(n=1100, buckets=2, batch=8, replicas=2, drop_last=True)
+    overlap = make_sampler(
+        n=1100, buckets=2, batch=8, replicas=2, drop_last=True, allow_bucket_overlap=True
+    )
+    assert len(overlap) >= len(base)
+
+
+def test_validation_gates():
+    # bucket smaller than one slice
+    with pytest.raises(ValueError):
+        make_sampler(n=120, buckets=8, batch=8, replicas=4)
+    # fewer than 2 slices per bucket
+    with pytest.raises(ValueError):
+        make_sampler(n=200, buckets=1, batch=100, replicas=2)
+    # bad rank
+    with pytest.raises(ValueError):
+        make_sampler(rank=5, replicas=2)
+
+
+# ----------------------------- loader ------------------------------------- #
+
+
+def test_loader_places_on_device():
+    calls = []
+
+    def place(b):
+        calls.append(1)
+        return jax.tree_util.tree_map(jax.numpy.asarray, b)
+
+    dl = StokeDataLoader(SizedDataset(64), batch_size=16, place_fn=place)
+    batches = list(dl)
+    assert len(batches) == 4 and len(calls) == 4
+    assert isinstance(batches[0], jax.Array)
+    assert batches[0].shape == (16, 2)
+
+
+def test_loader_len_and_epoch_forwarding():
+    s = make_sampler(n=1000, buckets=2, batch=16, replicas=1, rank=0)
+    dl = StokeDataLoader(SizedDataset(1000), batch_size=16, place_fn=None, sampler=s)
+    assert len(dl) > 0
+    dl.set_epoch(3)
+    assert s.epoch == 3
+
+
+def test_loader_no_place_passthrough():
+    dl = StokeDataLoader(SizedDataset(8), batch_size=4, place_fn=None, place=False)
+    b = next(iter(dl))
+    assert isinstance(b, np.ndarray)
+
+
+def test_loader_prefetch_order_preserved():
+    dl = StokeDataLoader(
+        SizedDataset(64), batch_size=8, place_fn=lambda b: b, prefetch=3, shuffle=False
+    )
+    firsts = [b[0][0] for b in dl]
+    assert firsts == sorted(firsts)

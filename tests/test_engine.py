@@ -1,0 +1,130 @@
+"""Engine unit tests: precision policy, functional loss scaler, grad clip,
+optimizer build (stoke_tpu/engine.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from stoke_tpu.configs import (
+    ClipGradConfig,
+    ClipGradNormConfig,
+    PrecisionConfig,
+    PrecisionOptions,
+)
+from stoke_tpu.engine import (
+    PrecisionPolicy,
+    _scaler_update,
+    build_optimizer,
+    clip_gradients,
+    init_scaler_state,
+)
+
+
+# ------------------------- precision policy ------------------------------ #
+
+
+def test_precision_policy_full():
+    p = PrecisionPolicy.make(PrecisionOptions.full, PrecisionConfig())
+    assert p.compute_dtype is None and not p.scaled
+    x = {"w": jnp.ones((2, 2), jnp.float32)}
+    assert p.cast_compute(x)["w"].dtype == jnp.float32
+
+
+def test_precision_policy_bf16():
+    """bf16: compute cast, fp32 master params, NO scaler (SURVEY.md §3.2c)."""
+    p = PrecisionPolicy.make(PrecisionOptions.bf16, PrecisionConfig())
+    assert p.compute_dtype == jnp.bfloat16 and not p.scaled
+    x = {"w": jnp.ones((2, 2), jnp.float32), "i": jnp.ones((2,), jnp.int32)}
+    c = p.cast_compute(x)
+    assert c["w"].dtype == jnp.bfloat16
+    assert c["i"].dtype == jnp.int32  # integer leaves untouched
+
+
+def test_precision_policy_fp16_scaled():
+    p = PrecisionPolicy.make(PrecisionOptions.fp16, PrecisionConfig())
+    assert p.compute_dtype == jnp.float16 and p.scaled
+
+
+# ------------------------- functional scaler ------------------------------ #
+
+
+def test_scaler_growth_and_backoff():
+    cfg = PrecisionConfig(init_scale=1024.0, growth_interval=2, growth_factor=2.0,
+                          backoff_factor=0.5, min_scale=1.0)
+    st = init_scaler_state(cfg)
+    # finite step 1: count 0→1, no growth
+    st = _scaler_update(st, jnp.asarray(True), cfg)
+    assert float(st["scale"]) == 1024.0 and int(st["growth_count"]) == 1
+    # finite step 2: interval reached → grow, count resets
+    st = _scaler_update(st, jnp.asarray(True), cfg)
+    assert float(st["scale"]) == 2048.0 and int(st["growth_count"]) == 0
+    # overflow: back off, count resets
+    st = _scaler_update(st, jnp.asarray(False), cfg)
+    assert float(st["scale"]) == 1024.0 and int(st["growth_count"]) == 0
+
+
+def test_scaler_floor():
+    cfg = PrecisionConfig(init_scale=1.5, backoff_factor=0.5, min_scale=1.0)
+    st = init_scaler_state(cfg)
+    for _ in range(5):
+        st = _scaler_update(st, jnp.asarray(False), cfg)
+    assert float(st["scale"]) == 1.0
+
+
+# ------------------------- grad clipping ---------------------------------- #
+
+
+def test_clip_by_value():
+    g = {"a": jnp.asarray([-5.0, 0.2, 5.0])}
+    out = clip_gradients(g, ClipGradConfig(clip_value=1.0))
+    np.testing.assert_allclose(np.asarray(out["a"]), [-1.0, 0.2, 1.0])
+
+
+def test_clip_by_global_norm_matches_optax():
+    gs = {
+        "a": jnp.asarray(np.random.default_rng(0).normal(size=(17,)), jnp.float32),
+        "b": jnp.asarray(np.random.default_rng(1).normal(size=(5, 3)), jnp.float32),
+    }
+    ours = clip_gradients(gs, ClipGradNormConfig(max_norm=0.5, norm_type=2.0))
+    ref, _ = optax.clip_by_global_norm(0.5).update(gs, optax.clip_by_global_norm(0.5).init(gs))
+    for k in gs:
+        np.testing.assert_allclose(np.asarray(ours[k]), np.asarray(ref[k]), rtol=2e-4)
+
+
+def test_clip_norm_noop_when_small():
+    g = {"a": jnp.asarray([0.01, -0.01])}
+    out = clip_gradients(g, ClipGradNormConfig(max_norm=10.0))
+    np.testing.assert_allclose(np.asarray(out["a"]), np.asarray(g["a"]), rtol=1e-5)
+
+
+def test_clip_inf_norm():
+    g = {"a": jnp.asarray([3.0, -6.0])}
+    out = clip_gradients(g, ClipGradNormConfig(max_norm=3.0, norm_type=np.inf))
+    np.testing.assert_allclose(np.asarray(out["a"]), [1.5, -3.0], rtol=1e-5)
+
+
+def test_no_clip_passthrough():
+    g = {"a": jnp.asarray([3.0])}
+    assert clip_gradients(g, None) is g
+
+
+# ------------------------- optimizer build -------------------------------- #
+
+
+def test_build_optimizer_from_typed_dict():
+    opt = build_optimizer({"optimizer": optax.sgd, "optimizer_kwargs": {"learning_rate": 0.1}})
+    assert isinstance(opt, optax.GradientTransformation)
+
+
+def test_build_optimizer_passthrough():
+    base = optax.adam(1e-3)
+    assert build_optimizer(base) is base
+
+
+def test_build_optimizer_rejects_junk():
+    with pytest.raises(TypeError):
+        build_optimizer({"optimizer": lambda: 42})
+    with pytest.raises(TypeError):
+        build_optimizer(3)

@@ -1,0 +1,306 @@
+"""Facade behavior tests: the 4-call contract, grad accumulation semantics,
+deferred outputs, multi-loss, fp16 skip-on-overflow, counters, mode toggles
+(stoke_tpu/facade.py vs reference stoke/stoke.py:853-1040)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from stoke_tpu import (
+    ClipGradConfig,
+    ClipGradNormConfig,
+    DeferredOutput,
+    ParamNormalize,
+    PrecisionConfig,
+    Stoke,
+    StokeOptimizer,
+)
+
+
+def linear_model(params, x):
+    return x @ params["w"] + params["b"]
+
+
+def mse(out, y):
+    return jnp.mean((out - y) ** 2)
+
+
+def make_stoke(loss=mse, model=linear_model, in_dim=4, out_dim=2, **kw):
+    params = {"w": jnp.zeros((in_dim, out_dim)), "b": jnp.zeros((out_dim,))}
+    kw.setdefault("batch_size_per_device", 8)
+    kw.setdefault("verbose", False)
+    opt = kw.pop("optimizer", StokeOptimizer(optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.2}))
+    return Stoke(model=model, optimizer=opt, loss=loss, params=params, **kw)
+
+
+def batch(rng, n=8, in_dim=4, out_dim=2, W=None):
+    x = rng.normal(size=(n, in_dim)).astype(np.float32)
+    W = np.ones((in_dim, out_dim), np.float32) if W is None else W
+    return x, (x @ W).astype(np.float32)
+
+
+def test_four_call_training_converges(rng):
+    s = make_stoke()
+    for _ in range(60):
+        x, y = batch(rng)
+        out = s.model(x)
+        l = s.loss(out, y)
+        s.backward(l)
+        s.step()
+    assert float(l) < 1e-3
+    assert s.optimizer_steps == 60
+    assert s.backward_steps == 60
+
+
+def test_grad_accum_equivalence(rng):
+    """accum=4 on batch b must match accum=1 on the concatenated 4b batch
+    (the semantics the reference implements with counters + no_sync,
+    stoke.py:326-344)."""
+    xs, ys = zip(*[batch(rng, n=8) for _ in range(4)])
+    bigx, bigy = np.concatenate(xs), np.concatenate(ys)
+
+    s1 = make_stoke(grad_accum=1, batch_size_per_device=32)
+    out = s1.model(bigx)
+    s1.backward(s1.loss(out, bigy))
+    s1.step()
+
+    s4 = make_stoke(grad_accum=4, batch_size_per_device=8)
+    for x, y in zip(xs, ys):
+        out = s4.model(x)
+        s4.backward(s4.loss(out, y))
+        s4.step()
+    assert s4.optimizer_steps == 1  # only stepped at the boundary
+    np.testing.assert_allclose(
+        np.asarray(s1.params["w"]), np.asarray(s4.params["w"]), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_step_is_noop_before_accum_boundary(rng):
+    s = make_stoke(grad_accum=2)
+    x, y = batch(rng)
+    s.backward(s.loss(s.model(x), y))
+    w_before = np.asarray(s.params["w"]).copy()
+    s.step()  # counter=1 < 2 → no-op
+    np.testing.assert_array_equal(w_before, np.asarray(s.params["w"]))
+    assert s.optimizer_steps == 0
+    s.backward(s.loss(s.model(x), y))
+    s.step()
+    assert s.optimizer_steps == 1
+
+
+def test_loss_divided_by_accum(rng):
+    """Training losses are returned divided by grad_accum
+    (reference stoke.py:901-911)."""
+    x, y = batch(rng)
+    s1 = make_stoke(grad_accum=1)
+    l1 = float(s1.loss(s1.model(x), y))
+    s2 = make_stoke(grad_accum=4)
+    l2 = float(s2.loss(s2.model(x), y))
+    assert l1 == pytest.approx(4 * l2, rel=1e-5)
+
+
+def test_no_backward_no_grads(rng):
+    """Calling loss() without backward() must not contribute gradients."""
+    s = make_stoke(grad_accum=1)
+    x, y = batch(rng)
+    s.loss(s.model(x), y)  # dropped pending
+    x2, y2 = batch(rng)
+    out = s.model(x2)
+    s.backward(s.loss(out, y2))
+    s.step()
+
+    s_ref = make_stoke(grad_accum=1)
+    out = s_ref.model(x2)
+    s_ref.backward(s_ref.loss(out, y2))
+    s_ref.step()
+    np.testing.assert_allclose(
+        np.asarray(s.params["w"]), np.asarray(s_ref.params["w"]), rtol=1e-6
+    )
+
+
+def test_backward_without_loss_raises(rng):
+    s = make_stoke()
+    with pytest.raises(RuntimeError):
+        s.backward(None)
+
+
+def test_eval_mode(rng):
+    s = make_stoke()
+    x, y = batch(rng)
+    s.eval()
+    out = s.model(x)  # eager in eval mode
+    assert isinstance(out, jax.Array)
+    l = s.loss(out, y)
+    assert float(l) > 0
+    with pytest.raises(RuntimeError):
+        s.backward(l)
+    s.train()
+    out = s.model(x)
+    assert isinstance(out, DeferredOutput)
+
+
+def test_deferred_materialization_matches_fused(rng):
+    """Materializing out.value must agree with what the fused step saw."""
+    s = make_stoke()
+    x, y = batch(rng)
+    out = s.model(x)
+    val = np.asarray(out.value)
+    l = float(s.loss(out, y))
+    manual = float(np.mean((val - y) ** 2))
+    assert l == pytest.approx(manual, rel=1e-5)
+
+
+def test_deferred_path_extraction(rng):
+    """out[idx] handles route through the fused step (tuple-output model)."""
+
+    def model2(params, x):
+        h = x @ params["w"] + params["b"]
+        return h, h * 2
+
+    s = make_stoke(model=model2)
+    x, y = batch(rng)
+    out = s.model(x)
+    l = s.loss(out[0], y)
+    s.backward(l)
+    s.step()
+    assert s.optimizer_steps == 1
+    np.testing.assert_allclose(np.asarray(out[1]), 2 * np.asarray(out[0]), rtol=1e-5)
+
+
+def test_stale_deferred_rejected(rng):
+    s = make_stoke()
+    x, y = batch(rng)
+    out_old = s.model(x)
+    s.model(x)  # new call invalidates the old handle
+    with pytest.raises(RuntimeError):
+        s.loss(out_old, y)
+
+
+def test_multi_loss_tuple(rng):
+    """Multi-loss: grads of the SUM, per-loss values reported
+    (reference stoke.py:891-902, fp16.py:274-278)."""
+
+    def two_losses(out, y):
+        return (jnp.mean((out - y) ** 2), 0.01 * jnp.mean(out**2))
+
+    s = make_stoke(loss=two_losses)
+    x, y = batch(rng)
+    out = s.model(x)
+    l = s.loss(out, y)
+    assert isinstance(l, tuple) and len(l) == 2
+    s.backward(l)
+    s.step()
+
+    # equivalent single summed loss must give identical params
+    def summed(out, y):
+        return jnp.mean((out - y) ** 2) + 0.01 * jnp.mean(out**2)
+
+    s2 = make_stoke(loss=summed)
+    out = s2.model(x)
+    s2.backward(s2.loss(out, y))
+    s2.step()
+    np.testing.assert_allclose(
+        np.asarray(s.params["w"]), np.asarray(s2.params["w"]), rtol=1e-6
+    )
+
+
+def test_grad_clip_value_effect(rng):
+    """With a harsh value clip, the SGD update is bounded by lr*clip."""
+    s = make_stoke(
+        grad_clip=ClipGradConfig(clip_value=0.001),
+        optimizer=StokeOptimizer(optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 1.0}),
+    )
+    x, y = batch(rng, W=100 * np.ones((4, 2), np.float32))  # huge grads
+    s.backward(s.loss(s.model(x), y))
+    s.step()
+    assert np.abs(np.asarray(s.params["w"])).max() <= 0.001 + 1e-6
+
+
+def test_fp16_overflow_skips_step(rng):
+    """fp16 scaler: an overflowing micro-batch must skip the optimizer step
+    and back off the scale (GradScaler semantics, reference fp16.py:788-806)."""
+
+    def exploding_loss(out, y):
+        return jnp.mean((out - y) ** 2) * 1e30
+
+    s = make_stoke(loss=exploding_loss, precision="fp16")
+    x, y = batch(rng)
+    w_before = np.asarray(s.params["w"]).copy()
+    scale_before = s.loss_scale
+    s.backward(s.loss(s.model(x), y))
+    s.step()
+    np.testing.assert_array_equal(w_before, np.asarray(s.params["w"]))
+    assert s.loss_scale == scale_before * 0.5
+    assert s.skipped_optimizer_steps == 1.0
+
+
+def test_fp16_normal_training_converges(rng):
+    s = make_stoke(
+        precision="fp16",
+        configs=[PrecisionConfig(init_scale=2.0**8)],
+    )
+    for _ in range(60):
+        x, y = batch(rng)
+        s.backward(s.loss(s.model(x), y))
+        s.step()
+    assert float(s.ema_loss) < 0.05
+
+
+def test_bf16_training_converges(rng):
+    s = make_stoke(precision="bf16")
+    for _ in range(60):
+        x, y = batch(rng)
+        s.backward(s.loss(s.model(x), y))
+        s.step()
+    assert float(s.ema_loss) < 0.05
+    # master params stay fp32
+    assert s.params["w"].dtype == jnp.float32
+
+
+def test_loss_tracking_helpers(rng, capsys):
+    s = make_stoke(grad_accum=2)
+    x, y = batch(rng)
+    s.backward(s.loss(s.model(x), y))
+    assert s.ema_loss > 0
+    assert s.mean_accumulated_loss is not None
+    assert s.step_loss is not None
+    s.print_ema_loss()
+    s.print_mean_accumulated_synced_loss()
+    s.print_synced_loss(s.step_loss and s._last_step_loss)
+    out = capsys.readouterr().out
+    assert "EMA Loss" in out and "Stoke --" in out
+
+
+def test_properties_and_introspection(rng, capsys):
+    s = make_stoke(grad_accum=3)
+    assert s.batch_size == 8
+    assert s.effective_batch_size == 8 * 1 * 3
+    assert s.grad_accum_steps == 3
+    assert s.world_size == 1
+    assert s.rank == 0 and s.is_rank_0
+    assert not s.is_distributed
+    assert s.num_model_parameters() == 4 * 2 + 2
+    assert s.num_model_parameters(ParamNormalize.THOUSAND) == pytest.approx(0.01)
+    s.print_num_model_parameters()
+    s.dump_model_parameter_info()
+    out = capsys.readouterr().out
+    assert "Model parameters" in out and "param w" in out
+    assert callable(s.loss_access)
+    assert s.optimizer is not None
+
+
+def test_reset(rng):
+    s = make_stoke(grad_accum=4)
+    x, y = batch(rng)
+    s.backward(s.loss(s.model(x), y))
+    assert s.grad_accum_counter == 1
+    s.reset()
+    assert s.grad_accum_counter == 0
+    buf = np.asarray(jax.tree_util.tree_leaves(s._grad_buf)[0])
+    assert (buf == 0).all()
+
+
+def test_barrier_noop_single_process():
+    make_stoke().barrier()  # must not raise

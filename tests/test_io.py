@@ -1,0 +1,208 @@
+"""Checkpoint IO tests: consolidated + sharded roundtrips, counter restore,
+cross-format and cross-topology loads (reference io_ops.py semantics,
+SURVEY.md §7 hard part #4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from stoke_tpu import (
+    CheckpointConfig,
+    CheckpointFormat,
+    FSDPConfig,
+    Stoke,
+    StokeOptimizer,
+)
+
+
+def mlp(params, x):
+    return jax.nn.relu(x @ params["w1"]) @ params["w2"]
+
+
+def mse(out, y):
+    return jnp.mean((out - y) ** 2)
+
+
+def make(distributed=None, fmt=CheckpointFormat.consolidated, **kw):
+    r = np.random.default_rng(5)
+    params = {
+        "w1": jnp.asarray(r.normal(size=(8, 32)).astype(np.float32) * 0.1),
+        "w2": jnp.asarray(r.normal(size=(32, 4)).astype(np.float32) * 0.1),
+    }
+    cfgs = list(kw.pop("configs", []))
+    cfgs.append(CheckpointConfig(format=fmt, max_to_keep=kw.pop("max_keep", None)))
+    if distributed:
+        cfgs.append(FSDPConfig(min_weight_size=1))
+    return Stoke(
+        model=mlp,
+        optimizer=StokeOptimizer(optimizer=optax.adam, optimizer_kwargs={"learning_rate": 1e-2}),
+        loss=mse,
+        params=params,
+        batch_size_per_device=4 if distributed else 32,
+        distributed=distributed,
+        verbose=False,
+        configs=cfgs,
+        **kw,
+    )
+
+
+def train_a_bit(s, steps=3):
+    r = np.random.default_rng(1)
+    W = r.normal(size=(8, 4)).astype(np.float32)
+    for _ in range(steps):
+        x = r.normal(size=(32, 8)).astype(np.float32)
+        y = (x @ W).astype(np.float32)
+        s.backward(s.loss(s.model(x), y))
+        s.step()
+    return s
+
+
+@pytest.mark.parametrize("fmt", [CheckpointFormat.consolidated, CheckpointFormat.sharded])
+def test_roundtrip_single_device(fmt, tmp_path):
+    s = train_a_bit(make(fmt=fmt))
+    path = str(tmp_path / "ckpt")
+    tag_dir = s.save(path, name="test", extras={"note": "hello"})
+    assert "stoke-test-backward-step-3" in tag_dir
+
+    s2 = make(fmt=fmt)
+    extras = s2.load(path, name="test")
+    assert extras == {"note": "hello"}
+    assert s2.backward_steps == 3 and s2.optimizer_steps == 3
+    np.testing.assert_allclose(
+        np.asarray(s2.params["w1"]), np.asarray(s.params["w1"]), rtol=1e-6
+    )
+    # optimizer state restored too
+    l1 = jax.tree_util.tree_leaves(s.opt_state)
+    l2 = jax.tree_util.tree_leaves(s2.opt_state)
+    for a, b in zip(l1, l2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+@pytest.mark.parametrize("fmt", [CheckpointFormat.consolidated, CheckpointFormat.sharded])
+def test_roundtrip_fsdp_sharded_state(fmt, tmp_path, devices):
+    """FSDP-sharded params must save and restore onto the declared shardings
+    (the consolidation/extraction dance of reference io_ops.py:569-600)."""
+    s = train_a_bit(make(distributed="dp", fmt=fmt))
+    path = str(tmp_path / "ckpt")
+    s.save(path)
+    s2 = make(distributed="dp", fmt=fmt)
+    s2.load(path)
+    np.testing.assert_allclose(
+        np.asarray(s2.params["w1"]), np.asarray(s.params["w1"]), rtol=1e-6
+    )
+    assert s2.params["w1"].sharding.spec == s.params["w1"].sharding.spec
+
+
+def test_cross_topology_consolidated(tmp_path, devices):
+    """Save on 8-device FSDP, load on single device — topology change the
+    reference cannot do across backends."""
+    s = train_a_bit(make(distributed="dp", fmt=CheckpointFormat.consolidated))
+    path = str(tmp_path / "ckpt")
+    s.save(path)
+    s1 = make(distributed=None)
+    s1.load(path)
+    np.testing.assert_allclose(
+        np.asarray(s1.params["w1"]), np.asarray(s.params["w1"]), rtol=1e-6
+    )
+
+
+def test_resume_continues_identically(tmp_path):
+    """Save at step 3, keep training to 6; reload at 3 and retrain → same."""
+    s = train_a_bit(make(), steps=3)
+    path = str(tmp_path / "ckpt")
+    s.save(path)
+    s = train_a_bit(s, steps=3)
+    w_direct = np.asarray(s.params["w1"])
+
+    s2 = make()
+    s2.load(path)
+    s2 = train_a_bit(s2, steps=3)
+    np.testing.assert_allclose(np.asarray(s2.params["w1"]), w_direct, rtol=1e-5)
+
+
+def test_mid_window_resume_keeps_gradient_mass(tmp_path):
+    """Saving mid-accumulation-window persists the partial grad buffer, so a
+    resumed run's next optimizer step loses no gradient mass (beyond the
+    reference, which cannot save torch .grad)."""
+    r = np.random.default_rng(2)
+    W = r.normal(size=(8, 4)).astype(np.float32)
+    xs = [r.normal(size=(32, 8)).astype(np.float32) for _ in range(2)]
+    ys = [(x @ W).astype(np.float32) for x in xs]
+
+    def half_then_step(s, path=None):
+        s.backward(s.loss(s.model(xs[0]), ys[0]))
+        if path:
+            s.save(path)
+        s.backward(s.loss(s.model(xs[1]), ys[1]))
+        s.step()
+        return np.asarray(s.params["w1"])
+
+    s_direct = make(grad_accum=2)
+    w_direct = half_then_step(s_direct)
+
+    s_save = make(grad_accum=2)
+    path = str(tmp_path / "ckpt")
+    half_then_step(s_save, path=path)
+
+    s_resume = make(grad_accum=2)
+    s_resume.load(path)
+    assert s_resume.grad_accum_counter == 1
+    s_resume.backward(s_resume.loss(s_resume.model(xs[1]), ys[1]))
+    s_resume.step()
+    assert s_resume.optimizer_steps == 1
+    np.testing.assert_allclose(np.asarray(s_resume.params["w1"]), w_direct, rtol=1e-5)
+
+
+def test_load_name_scoped(tmp_path):
+    """Two runs sharing a directory must not load each other's newest tag."""
+    sA = train_a_bit(make(), steps=1)
+    path = str(tmp_path / "ckpt")
+    sA.save(path, name="runA")
+    sB = train_a_bit(make(), steps=2)
+    sB.save(path, name="runB")
+    s = make()
+    s.load(path, name="runA")
+    assert s.backward_steps == 1  # runA's newest, not runB's
+
+
+def test_latest_tag_selection(tmp_path):
+    s = train_a_bit(make(), steps=1)
+    path = str(tmp_path / "ckpt")
+    s.save(path)
+    s = train_a_bit(s, steps=1)
+    s.save(path)
+    s2 = make()
+    s2.load(path)  # tag=None → newest
+    assert s2.backward_steps == 2
+
+
+def test_max_to_keep(tmp_path):
+    import os
+
+    s = make(max_keep=2)
+    path = str(tmp_path / "ckpt")
+    for _ in range(4):
+        s = train_a_bit(s, steps=1)
+        s.save(path)
+    tags = [d for d in os.listdir(path) if d.startswith("stoke-")]
+    assert len(tags) == 2
+
+
+def test_structure_mismatch_rejected(tmp_path):
+    s = train_a_bit(make())
+    path = str(tmp_path / "ckpt")
+    s.save(path)
+
+    r = np.random.default_rng(5)
+    other = Stoke(
+        model=lambda p, x: x @ p["only"],
+        optimizer=StokeOptimizer(optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.1}),
+        loss=mse,
+        params={"only": jnp.zeros((8, 4))},
+        batch_size_per_device=4,
+        verbose=False,
+    )
+    with pytest.raises(ValueError):
+        other.load(path)

@@ -1,0 +1,126 @@
+"""Sharding-rule and mesh tests (stoke_tpu/parallel/*) on the 8-device
+simulated CPU mesh (SURVEY.md §4)."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from stoke_tpu.configs import (
+    DeviceOptions,
+    FSDPConfig,
+    MeshConfig,
+    OSSConfig,
+    SDDPConfig,
+    ShardingOptions,
+)
+from stoke_tpu.parallel.mesh import build_mesh
+from stoke_tpu.parallel.sharding import leaf_partition_spec, make_sharding_rules
+
+
+def mesh8():
+    return build_mesh(MeshConfig(), DeviceOptions.cpu, True)
+
+
+def test_build_mesh_default_1d(devices):
+    m = mesh8()
+    assert m.shape == {"data": 8}
+
+
+def test_build_mesh_no_distributed():
+    assert build_mesh(MeshConfig(), DeviceOptions.cpu, False) is None
+
+
+def test_build_mesh_2d_with_inference(devices):
+    m = build_mesh(
+        MeshConfig(axes=("data", "model"), shape=(-1, 2)), DeviceOptions.cpu, True
+    )
+    assert m.shape == {"data": 4, "model": 2}
+
+
+def test_build_mesh_bad_shape(devices):
+    with pytest.raises(ValueError):
+        build_mesh(MeshConfig(axes=("data",), shape=(3,)), DeviceOptions.cpu, True)
+
+
+@pytest.mark.parametrize(
+    "shape,expected",
+    [
+        ((64, 16), P("data", None)),  # largest divisible dim = 0
+        ((16, 64), P(None, "data")),  # largest divisible dim = 1
+        ((7, 5), P()),  # nothing divisible by 8
+        ((8,), P("data")),
+        ((), P()),  # scalar
+        ((3, 2), P()),  # too small (min_size)
+    ],
+)
+def test_leaf_partition_spec(shape, expected):
+    assert leaf_partition_spec(shape, "data", 8, min_size=8) == expected
+
+
+def test_leaf_partition_spec_min_size_guard():
+    # large enough dims but below min_size stay replicated
+    assert leaf_partition_spec((8, 2), "data", 8, min_size=1000) == P()
+    assert leaf_partition_spec((8, 2), "data", 8, min_size=16) == P("data", None)
+
+
+def test_leaf_partition_spec_first_preference():
+    assert (
+        leaf_partition_spec((8, 64), "data", 8, min_size=0, preference="first")
+        == P("data", None)
+    )
+    # dim0 not divisible → falls to replicated under "first" if no dim0 match
+    assert (
+        leaf_partition_spec((7, 64), "data", 8, min_size=0, preference="first") == P()
+    )
+
+
+TIER_EXPECTATIONS = {
+    # tier → (param sharded?, grad sharded?, opt sharded?)
+    ShardingOptions.none: (False, False, False),
+    ShardingOptions.oss: (False, False, True),
+    ShardingOptions.sddp: (False, True, True),
+    ShardingOptions.fsdp: (True, True, True),
+}
+
+
+@pytest.mark.parametrize("tier", list(TIER_EXPECTATIONS))
+def test_tier_ladder(tier, devices):
+    """The ZeRO ladder as placement rules (reference extensions.py:81-376)."""
+    rules = make_sharding_rules(
+        tier,
+        mesh8(),
+        "data",
+        OSSConfig(min_shard_size=1),
+        SDDPConfig(min_shard_size=1),
+        FSDPConfig(min_weight_size=1),
+    )
+    shape = (16, 64)
+    p_sharded, g_sharded, o_sharded = TIER_EXPECTATIONS[tier]
+    assert (rules.param_spec(shape) != P()) == p_sharded
+    assert (rules.grad_spec(shape) != P()) == g_sharded
+    assert (rules.opt_spec(shape) != P()) == o_sharded
+
+
+def test_rules_build_sharding_trees(devices):
+    rules = make_sharding_rules(
+        ShardingOptions.fsdp,
+        mesh8(),
+        "data",
+        OSSConfig(),
+        SDDPConfig(),
+        FSDPConfig(min_weight_size=1),
+    )
+    tree = {"a": np.zeros((16, 64)), "b": {"c": np.zeros((3,))}}
+    sh = rules.param_shardings(tree)
+    assert sh["a"].spec == P(None, "data")
+    assert sh["b"]["c"].spec == P()  # not divisible → replicated
+
+
+def test_no_mesh_no_rules():
+    assert (
+        make_sharding_rules(
+            ShardingOptions.none, None, "data", OSSConfig(), SDDPConfig(), FSDPConfig()
+        )
+        is None
+    )

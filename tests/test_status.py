@@ -1,0 +1,157 @@
+"""Status/validation layer tests — the combination matrix is table-driven
+(SURVEY.md §4: "a table-driven test goldmine", reference status.py:192-289)."""
+
+import pytest
+
+from stoke_tpu import (
+    ClipGradConfig,
+    ClipGradNormConfig,
+    DeviceOptions,
+    DistributedOptions,
+    OSSConfig,
+    PrecisionOptions,
+    ShardingOptions,
+    StokeStatus,
+    StokeValidationError,
+)
+
+
+# (kwargs, should_raise) — enumerating the legality matrix
+MATRIX = [
+    # basics
+    (dict(batch_size_per_device=8), False),
+    (dict(batch_size_per_device=0), True),
+    (dict(batch_size_per_device=8, grad_accum=0), True),
+    (dict(batch_size_per_device=8, grad_accum=4), False),
+    # sharding ladder requires distributed (reference status.py:231-263)
+    (dict(batch_size_per_device=8, oss=True), True),
+    (dict(batch_size_per_device=8, sddp=True), True),
+    (dict(batch_size_per_device=8, fsdp=True), True),
+    (dict(batch_size_per_device=8, distributed="dp", oss=True), False),
+    # sddp requires oss (reference status.py:240-243)
+    (dict(batch_size_per_device=8, distributed="dp", sddp=True), True),
+    (dict(batch_size_per_device=8, distributed="dp", oss=True, sddp=True), False),
+    # fsdp excludes oss/sddp (reference status.py:244-263)
+    (dict(batch_size_per_device=8, distributed="dp", fsdp=True), False),
+    (dict(batch_size_per_device=8, distributed="dp", fsdp=True, oss=True), True),
+    (
+        dict(batch_size_per_device=8, distributed="dp", fsdp=True, oss=True, sddp=True),
+        True,
+    ),
+    # precision anywhere
+    (dict(batch_size_per_device=8, precision="bf16"), False),
+    (dict(batch_size_per_device=8, precision="fp16"), False),
+    (dict(batch_size_per_device=8, device="tpu", precision="bf16"), False),
+]
+
+
+@pytest.mark.parametrize("kwargs,should_raise", MATRIX)
+def test_combination_matrix(kwargs, should_raise):
+    if should_raise:
+        with pytest.raises(StokeValidationError):
+            StokeStatus(**kwargs)
+    else:
+        StokeStatus(**kwargs)
+
+
+def test_reference_aliases():
+    """Reference users select {ddp, horovod, deepspeed} — all collapse to the
+    one SPMD dp engine (SURVEY.md §2.9)."""
+    for alias in ("ddp", "horovod", "deepspeed", "xla", "dp"):
+        st = StokeStatus(batch_size_per_device=4, distributed=alias)
+        assert st.distributed is DistributedOptions.dp
+    for alias, expect in [
+        ("amp", PrecisionOptions.bf16),
+        ("apex_O1", PrecisionOptions.bf16),
+        ("apex_O2", PrecisionOptions.bf16),
+        ("deepspeed", PrecisionOptions.bf16),
+        ("fp16", PrecisionOptions.fp16),
+        ("float16", PrecisionOptions.fp16),
+        ("bf16", PrecisionOptions.bf16),
+        ("fp32", PrecisionOptions.full),
+    ]:
+        st = StokeStatus(batch_size_per_device=4, precision=alias)
+        assert st.precision is expect, alias
+
+
+def test_unknown_options_raise():
+    with pytest.raises(StokeValidationError):
+        StokeStatus(batch_size_per_device=4, distributed="nccl")
+    with pytest.raises(StokeValidationError):
+        StokeStatus(batch_size_per_device=4, precision="int8")
+    with pytest.raises(StokeValidationError):
+        StokeStatus(batch_size_per_device=4, device="gpu")
+
+
+def test_effective_batch_size():
+    """effective = per-device × world × accum (reference status.py:373-375)."""
+    st = StokeStatus(batch_size_per_device=8, grad_accum=4, distributed="dp")
+    assert st.effective_batch_size is None
+    st.set_post_init_values(world_size=8)
+    assert st.effective_batch_size == 8 * 8 * 4
+    assert st.world_size == 8
+
+
+def test_sharding_tier_collapse():
+    mk = lambda **kw: StokeStatus(batch_size_per_device=4, distributed="dp", **kw)
+    assert mk().sharding_tier is ShardingOptions.none
+    assert mk(oss=True).sharding_tier is ShardingOptions.oss
+    assert mk(oss=True, sddp=True).sharding_tier is ShardingOptions.sddp
+    assert mk(fsdp=True).sharding_tier is ShardingOptions.fsdp
+
+
+def test_config_dedupe_warns():
+    """Duplicate configs keep the last one (reference status.py:321-343)."""
+    a, b = OSSConfig(min_shard_size=1), OSSConfig(min_shard_size=2)
+    with pytest.warns(UserWarning):
+        st = StokeStatus(
+            batch_size_per_device=4, distributed="dp", oss=True, configs=[a, b]
+        )
+    assert st.oss_config.min_shard_size == 2
+
+
+def test_unknown_config_rejected():
+    class NotAConfig:
+        pass
+
+    with pytest.raises(StokeValidationError):
+        StokeStatus(batch_size_per_device=4, configs=[NotAConfig()])
+
+
+def test_lazy_default_configs():
+    st = StokeStatus(batch_size_per_device=4)
+    assert st.precision_config.init_scale == 2.0**16
+    assert st.dp_config.axis_name == "data"
+    assert st.mesh_config.axes == ("data",)
+    assert st.activation_checkpointing_config is None  # opt-in only
+
+
+def test_grad_clip_types():
+    StokeStatus(batch_size_per_device=4, grad_clip=ClipGradConfig(clip_value=0.5))
+    StokeStatus(batch_size_per_device=4, grad_clip=ClipGradNormConfig(max_norm=1.0))
+    with pytest.raises(StokeValidationError):
+        StokeStatus(batch_size_per_device=4, grad_clip=3.0)
+
+
+def test_to_dict_round_trippable():
+    import json
+
+    st = StokeStatus(
+        batch_size_per_device=4,
+        distributed="dp",
+        precision="bf16",
+        oss=True,
+        grad_clip=ClipGradNormConfig(max_norm=1.0),
+    )
+    st.set_post_init_values(8)
+    d = st.to_dict()
+    json.dumps(d)  # must be JSON-serializable (goes into checkpoints)
+    assert d["precision"] == "bf16"
+    assert d["oss"] is True
+    assert d["grad_clip"]["type"] == "ClipGradNormConfig"
+
+
+def test_repr_contains_flags():
+    st = StokeStatus(batch_size_per_device=4, precision="bf16")
+    r = repr(st)
+    assert "Stoke -- Status" in r and "bf16" in r
