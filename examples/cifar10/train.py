@@ -91,7 +91,10 @@ def build_stoke(cfg: dict) -> Stoke:
         model = ResNet50(num_classes=10, cifar_stem=True)
     else:
         raise ValueError(f"unknown model {model_name}")
-    variables = model.init(
+    from stoke_tpu import init_module
+
+    variables = init_module(
+        model,
         jax.random.PRNGKey(cfg.get("seed", 0)),
         np.zeros((2, 32, 32, 3), np.float32),
         train=False,

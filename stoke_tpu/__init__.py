@@ -28,7 +28,7 @@ from stoke_tpu.configs import (
     ShardingOptions,
     StokeOptimizer,
 )
-from stoke_tpu.data import BucketedDistributedSampler, StokeDataLoader
+from stoke_tpu.data import ArrayDataset, BucketedDistributedSampler, StokeDataLoader
 from stoke_tpu.engine import (
     DeferredOutput,
     FlaxModelAdapter,
@@ -37,6 +37,7 @@ from stoke_tpu.engine import (
 )
 from stoke_tpu.facade import Stoke
 from stoke_tpu.status import StokeStatus, StokeValidationError
+from stoke_tpu.utils import init_module
 
 __version__ = "0.1.0"
 
@@ -44,9 +45,11 @@ __all__ = [
     "Stoke",
     "StokeStatus",
     "StokeValidationError",
+    "init_module",
     "StokeOptimizer",
     "StokeDataLoader",
     "BucketedDistributedSampler",
+    "ArrayDataset",
     # enums
     "DeviceOptions",
     "DistributedOptions",

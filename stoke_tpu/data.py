@@ -31,6 +31,79 @@ import numpy as np
 
 
 # --------------------------------------------------------------------------- #
+# Array-backed dataset with native batching
+# --------------------------------------------------------------------------- #
+
+
+class ArrayDataset:
+    """Dataset backed by whole numpy arrays (first axis = samples).
+
+    When a ``StokeDataLoader`` receives one of these, it bypasses the
+    per-sample ``__getitem__`` + collate path entirely: each batch is
+    assembled by the native thread-pool (`stoke_tpu.native.NativeBatcher`)
+    as one GIL-free row-gather per array — the input-pipeline hot path the
+    reference delegates to torch's C++ DataLoader workers (SURVEY.md §2.6).
+
+    Args:
+        *arrays: equal-length numpy arrays (e.g. images, labels).
+    """
+
+    def __init__(self, *arrays: np.ndarray):
+        if not arrays:
+            raise ValueError("ArrayDataset needs at least one array")
+        self.arrays = tuple(np.ascontiguousarray(a) for a in arrays)
+        n = len(self.arrays[0])
+        if any(len(a) != n for a in self.arrays):
+            raise ValueError("all arrays must share the sample axis length")
+
+    def __len__(self):
+        return len(self.arrays[0])
+
+    def __getitem__(self, i):
+        row = tuple(a[i] for a in self.arrays)
+        return row if len(row) > 1 else row[0]
+
+
+class _NativeArrayLoader:
+    """Sampler-driven loader over an ArrayDataset using the native batcher."""
+
+    def __init__(self, dataset: ArrayDataset, batch_size: int,
+                 shuffle: bool = False, sampler=None, drop_last: bool = False,
+                 seed: int = 0, **_unused):
+        from stoke_tpu.native import NativeBatcher
+
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.sampler = sampler
+        self.drop_last = drop_last
+        self._epoch_seed = seed
+        self._batcher = NativeBatcher()
+
+    def __len__(self):
+        n = len(self.sampler) if self.sampler is not None else len(self.dataset)
+        return n // self.batch_size if self.drop_last else math.ceil(n / self.batch_size)
+
+    def __iter__(self):
+        if self.sampler is not None:
+            order = np.fromiter(iter(self.sampler), np.int64)
+        else:
+            order = np.arange(len(self.dataset), dtype=np.int64)
+            if self.shuffle:
+                rng = np.random.default_rng(self._epoch_seed)
+                self._epoch_seed += 1
+                rng.shuffle(order)
+        for start in range(0, len(order), self.batch_size):
+            idx = order[start : start + self.batch_size]
+            if self.drop_last and len(idx) < self.batch_size:
+                break
+            batch = tuple(
+                self._batcher.gather_rows(a, idx) for a in self.dataset.arrays
+            )
+            yield batch if len(batch) > 1 else batch[0]
+
+
+# --------------------------------------------------------------------------- #
 # Loader
 # --------------------------------------------------------------------------- #
 
@@ -120,6 +193,10 @@ class StokeDataLoader:
         self._place_fn = place_fn if place else None
         self._prefetch = max(int(prefetch), 1)
         self.batch_size = batch_size
+        if isinstance(dataset, ArrayDataset):
+            # native fast path: one GIL-free row-gather per array per batch
+            self._loader = _NativeArrayLoader(dataset, batch_size=batch_size, **kwargs)
+            return
         try:
             from torch.utils import data as torch_data
 

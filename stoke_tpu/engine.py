@@ -530,7 +530,9 @@ class StepEngine:
             variables, grad_buf, scaler_state, rng, margs, mkwargs, loss_args_flat
         )
 
-    def _build_accum(self, loss_treedef, deferred_info, training):
+    def _accum_core(self, loss_treedef, deferred_info, training):
+        """Unjitted micro-step core: forward + loss + grad + buffer add.
+        Shared by the lazy 4-call path and the fused train_step path."""
         inv_scale_accum = 1.0 / self.grad_accum if training else 1.0
         scaled = self.precision.scaled
 
@@ -576,6 +578,10 @@ class StepEngine:
                 new_buf = grad_buf
             return report, updated, new_buf, new_rng
 
+        return _step
+
+    def _build_accum(self, loss_treedef, deferred_info, training):
+        _step = self._accum_core(loss_treedef, deferred_info, training)
         if self.rules is not None:
             # Pin state outputs to the tier's placement so step-to-step
             # placement is deterministic (GSPMD would otherwise be free to
@@ -600,7 +606,8 @@ class StepEngine:
             self._apply_fn = self._build_apply()
         return self._apply_fn(variables, opt_state, grad_buf, scaler_state)
 
-    def _build_apply(self):
+    def _apply_core(self):
+        """Unjitted apply core, shared by step() and the fused train_step."""
         scaled = self.precision.scaled
         cfg = self.precision_config
         grad_clip = self.grad_clip
@@ -629,6 +636,10 @@ class StepEngine:
             zero_buf = tree_zeros_like(grad_buf)
             return new_vars, new_opt, zero_buf, new_scaler, finite
 
+        return _apply
+
+    def _build_apply(self):
+        _apply = self._apply_core()
         if self.rules is not None:
             out_sh = (
                 self._var_shardings,
@@ -639,6 +650,84 @@ class StepEngine:
             )
             return jax.jit(_apply, out_shardings=out_sh, donate_argnums=(0, 1, 2))
         return jax.jit(_apply, donate_argnums=(0, 1, 2))
+
+    # ------------------------ fused train step -------------------------- #
+
+    def fused_step(
+        self,
+        variables,
+        opt_state,
+        grad_buf,
+        scaler_state,
+        rng,
+        margs: tuple,
+        mkwargs: dict,
+        loss_args_flat: list,
+        loss_treedef,
+        deferred_info: Tuple[Tuple[int, Tuple], ...],
+        do_apply: bool,
+    ):
+        """ONE compiled dispatch for a whole micro-step — and, at the
+        accumulation boundary (``do_apply``), the optimizer apply fused in.
+
+        This is the TPU-idiomatic fast path behind ``Stoke.train_step``: with
+        ``grad_accum == 1`` an entire optimizer step (forward + loss + grad +
+        clip + update) is a single XLA program — no reference equivalent (the
+        reference's eager hot loop is stoke.py:853-1040).  The 4-call API
+        compiles the same math split across two dispatches.
+
+        Returns (report, updated_nonparam_vars, variables, opt_state,
+        grad_buf, scaler_state, rng, finite).
+        """
+        key = (
+            "fused",
+            jax.tree_util.tree_structure((margs, mkwargs)),
+            loss_treedef,
+            deferred_info,
+            bool(do_apply),
+        )
+        if key not in self._accum_cache:
+            self._accum_cache[key] = self._build_fused(
+                loss_treedef, deferred_info, bool(do_apply)
+            )
+        return self._accum_cache[key](
+            variables, opt_state, grad_buf, scaler_state, rng, margs, mkwargs,
+            loss_args_flat,
+        )
+
+    def _build_fused(self, loss_treedef, deferred_info, do_apply):
+        accum = self._accum_core(loss_treedef, deferred_info, training=True)
+        apply_core = self._apply_core()
+
+        def _fused(variables, opt_state, grad_buf, scaler_state, rng, margs,
+                   mkwargs, larr):
+            report, updated, new_buf, new_rng = accum(
+                variables, grad_buf, scaler_state, rng, margs, mkwargs, larr
+            )
+            merged = {**variables, **updated}
+            if do_apply:
+                new_vars, new_opt, zero_buf, new_scaler, finite = apply_core(
+                    merged, opt_state, new_buf, scaler_state
+                )
+                return (report, updated, new_vars, new_opt, zero_buf,
+                        new_scaler, new_rng, finite)
+            return (report, updated, merged, opt_state, new_buf, scaler_state,
+                    new_rng, jnp.asarray(True))
+
+        if self.rules is not None:
+            repl = self._repl
+            out_sh = (
+                None,  # report
+                None,  # updated collections
+                self._var_shardings,
+                self._opt_shardings,
+                self._grad_shardings,
+                {"scale": repl, "growth_count": repl},
+                repl,  # rng
+                repl,  # finite
+            )
+            return jax.jit(_fused, out_shardings=out_sh, donate_argnums=(0, 1, 2))
+        return jax.jit(_fused, donate_argnums=(0, 1, 2))
 
     # --------------------------- loss-only ----------------------------- #
 

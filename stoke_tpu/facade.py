@@ -205,6 +205,7 @@ class Stoke:
         self._agg_loss = self._zero_scalar()
         self._agg_count = 0
         self._rolling_mean_loss = self._zero_scalar()
+        self._ema_initialized = False
         self._ema_weight = float(ema_weight)
         self._skipped_steps = self._zero_scalar()
         self._last_step_loss = None
@@ -411,6 +412,86 @@ class Stoke:
         self._grad_accum_counter = 0
         self._reset_tracking_window()
 
+    def train_step(
+        self,
+        model_args: Any,
+        loss_args: Any = (),
+        model_kwargs: Optional[dict] = None,
+    ):
+        """Fused fast path: one compiled dispatch per micro-step, with the
+        optimizer apply fused in at the accumulation boundary.
+
+        Semantically identical to ``model → loss → backward → step`` (same
+        compiled math, same counters/EMA/scaler behavior) but with half the
+        dispatches — with ``grad_accum == 1`` a full optimizer step is ONE
+        XLA program.  The 4-call API remains for reference-contract parity;
+        use this in throughput-critical loops.
+
+        Args:
+            model_args: positional args for the model (a single array or a
+                tuple of arrays).
+            loss_args: extra args for the loss after the model output (a
+                single array or tuple): ``loss_fn(out, *loss_args)``.
+            model_kwargs: optional keyword args for the model.
+
+        Returns the loss report (divided by grad_accum, like ``loss()``).
+        """
+        if not self._training:
+            raise RuntimeError("Stoke -- train_step() called in eval mode")
+        if not isinstance(model_args, tuple):
+            model_args = (model_args,)
+        if not isinstance(loss_args, tuple):
+            loss_args = (loss_args,)
+        margs = self._place_batch(model_args)
+        mkwargs = self._place_batch(model_kwargs or {})
+        # loss call structure: loss_fn(out, *loss_args) — the model output
+        # slot is a deferred leaf at flat index 0 with an empty path
+        sentinel = DeferredOutput(None, -1)
+        flat, treedef = jax.tree_util.tree_flatten(
+            ((sentinel, *loss_args), {}), is_leaf=is_deferred
+        )
+        arrays = self._place_batch([l for l in flat if not is_deferred(l)])
+        deferred_info = tuple(
+            (i, l._path) for i, l in enumerate(flat) if is_deferred(l)
+        )
+        do_apply = self._grad_accum_counter + 1 >= self._status_obj.grad_accum
+        (
+            report,
+            _updated,
+            self._variables,
+            self._opt_state,
+            self._grad_buf,
+            self._scaler_state,
+            self._rng,
+            finite,
+        ) = self._engine.fused_step(
+            self._variables,
+            self._opt_state,
+            self._grad_buf,
+            self._scaler_state,
+            self._rng,
+            margs,
+            mkwargs,
+            arrays,
+            treedef,
+            deferred_info,
+            do_apply,
+        )
+        self._pending = None
+        self._backward_steps += 1
+        self._update_loss_tracking(report)
+        if do_apply:
+            if self._precision.scaled:
+                self._skipped_steps = self._skipped_steps + (
+                    1.0 - finite.astype(jnp.float32)
+                )
+            self._optimizer_steps += 1
+            self._grad_accum_counter = 0
+            self._reset_tracking_window()
+        else:
+            self._grad_accum_counter += 1
+        return report
+
     def reset(self) -> None:
         """Zero the accumulation buffer and counters without stepping
         (reference ``reset`` helpers, stoke.py:1042-1058)."""
@@ -437,11 +518,13 @@ class Stoke:
         self._agg_loss = self._agg_loss + micro
         self._agg_count += 1
         w = self._ema_weight
-        self._rolling_mean_loss = jnp.where(
-            self._backward_steps + self._agg_count <= 1,
-            micro,
-            (1.0 - w) * self._rolling_mean_loss + w * micro,
-        )
+        if not self._ema_initialized:
+            self._rolling_mean_loss = micro
+            self._ema_initialized = True
+        else:
+            self._rolling_mean_loss = (
+                1.0 - w
+            ) * self._rolling_mean_loss + w * micro
 
     def _reset_tracking_window(self) -> None:
         self._agg_loss = self._zero_scalar()
@@ -547,6 +630,78 @@ class Stoke:
         jax.block_until_ready(
             (self._variables, self._opt_state, self._grad_buf)
         )
+
+    # ------------------------------------------------------------------ #
+    # profiling / observability (SURVEY.md §5 — first-class here vs the
+    # reference's DeepSpeed flops-profiler passthrough, configs.py:252-279)
+    # ------------------------------------------------------------------ #
+
+    def profile_trace(self, name: str = "stoke"):
+        """Context manager capturing a ``jax.profiler`` trace (serves the
+        TensorBoard profile plugin / xprof) when ``ProfilerConfig.trace_dir``
+        is set; no-op otherwise.
+
+        Usage:
+            with stoke.profile_trace():
+                for batch in loader: ...
+        """
+        import contextlib
+
+        cfg = self._status_obj.profiler_config
+        if cfg.trace_dir is None:
+            return contextlib.nullcontext()
+
+        @contextlib.contextmanager
+        def _trace():
+            jax.profiler.start_trace(cfg.trace_dir)
+            try:
+                yield
+            finally:
+                jax.profiler.stop_trace()
+                self.info(f"profiler trace written to {cfg.trace_dir}")
+
+        return _trace()
+
+    def estimate_step_flops(
+        self, model_args: Any, loss_args: Any = ()
+    ) -> Optional[float]:
+        """XLA cost-analysis FLOPs estimate of one fused optimizer step
+        (replaces the reference's DeepSpeed flops profiler passthrough,
+        distributed.py:985-1004).  Returns None if the backend does not
+        report cost analysis."""
+        if not isinstance(model_args, tuple):
+            model_args = (model_args,)
+        if not isinstance(loss_args, tuple):
+            loss_args = (loss_args,)
+        from stoke_tpu.engine import DeferredOutput as _D
+
+        margs = self._place_batch(model_args)
+        sentinel = _D(None, -1)
+        flat, treedef = jax.tree_util.tree_flatten(
+            ((sentinel, *loss_args), {}), is_leaf=is_deferred
+        )
+        arrays = self._place_batch([l for l in flat if not is_deferred(l)])
+        deferred_info = tuple(
+            (i, l._path) for i, l in enumerate(flat) if is_deferred(l)
+        )
+        fn = self._engine._build_fused(treedef, deferred_info, True)
+        lowered = fn.lower(
+            self._variables,
+            self._opt_state,
+            self._grad_buf,
+            self._scaler_state,
+            self._rng,
+            margs,
+            {},
+            arrays,
+        )
+        try:
+            cost = lowered.compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0]
+            return float(cost.get("flops")) if cost else None
+        except Exception:
+            return None
 
     # ------------------------------------------------------------------ #
     # DataLoader factory (reference stoke.py:737-851)

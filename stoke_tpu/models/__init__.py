@@ -6,6 +6,14 @@ they are first-class flax modules used by the examples, the benchmark, and
 the driver entry point."""
 
 from stoke_tpu.models.basic import BasicNN
+from stoke_tpu.models.bert import (
+    BERT_SIZES,
+    BertBase,
+    BertEncoder,
+    BertForSequenceClassification,
+    BertTiny,
+    dense_attention,
+)
 from stoke_tpu.models.resnet import (
     ResNet,
     ResNet18,
@@ -17,6 +25,12 @@ from stoke_tpu.models.resnet import (
 
 __all__ = [
     "BasicNN",
+    "BERT_SIZES",
+    "BertBase",
+    "BertEncoder",
+    "BertForSequenceClassification",
+    "BertTiny",
+    "dense_attention",
     "ResNet",
     "ResNet18",
     "ResNet34",

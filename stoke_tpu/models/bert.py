@@ -1,0 +1,173 @@
+"""BERT-style transformer encoder for sequence classification.
+
+The reference's capability config #5 (BASELINE.md) is "BERT-base seq-cls with
+BucketedDistributedSampler + grad-accum/clip"; the reference itself ships no
+model code for it (stoke wraps user models).  This module provides the model
+as a first-class flax implementation, TPU-native:
+
+- NHWC-free: everything is [batch, seq, hidden] matmuls → MXU-friendly.
+- Attention is pluggable (``attention_fn``) so the same encoder runs dense
+  attention today and ring/flash attention (stoke_tpu.ops) for long context.
+- Padding-aware: additive attention masks from an int mask, mean/CLS pooling.
+
+Sizes follow the standard family table (base: 12 layers, hidden 768, 12
+heads, ff 3072).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class BertSize:
+    num_layers: int
+    hidden: int
+    heads: int
+    ff: int
+
+
+BERT_SIZES = {
+    "tiny": BertSize(2, 128, 2, 512),
+    "mini": BertSize(4, 256, 4, 1024),
+    "small": BertSize(4, 512, 8, 2048),
+    "medium": BertSize(8, 512, 8, 2048),
+    "base": BertSize(12, 768, 12, 3072),
+    "large": BertSize(24, 1024, 16, 4096),
+}
+
+
+def dense_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
+                    deterministic=True):
+    """Standard softmax attention: q/k/v [B, H, L, D], bias broadcastable to
+    [B, H, L, L].  The default ``attention_fn``; long-context variants
+    (ring attention over a mesh seq axis) plug in with the same signature."""
+    depth = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(depth).astype(q.dtype)
+    if bias is not None:
+        scores = scores + bias
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_rate > 0.0 and not deterministic:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = probs * keep / (1.0 - dropout_rate)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+class MultiHeadAttention(nn.Module):
+    hidden: int
+    heads: int
+    dropout_rate: float = 0.1
+    attention_fn: Callable = dense_attention
+
+    @nn.compact
+    def __call__(self, x, bias, deterministic: bool):
+        B, L, H = x.shape
+        head_dim = self.hidden // self.heads
+        qkv = nn.DenseGeneral((3, self.heads, head_dim), name="qkv")(x)
+        q, k, v = jnp.moveaxis(qkv, 2, 0)  # 3 × [B, L, heads, D]
+        q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))  # [B, H, L, D]
+        rng = None
+        if not deterministic and self.dropout_rate > 0.0:
+            rng = self.make_rng("dropout")
+        out = self.attention_fn(
+            q, k, v, bias,
+            dropout_rng=rng, dropout_rate=self.dropout_rate,
+            deterministic=deterministic,
+        )
+        out = jnp.swapaxes(out, 1, 2).reshape(B, L, self.hidden)
+        return nn.DenseGeneral(self.hidden, name="out")(out)
+
+
+class TransformerBlock(nn.Module):
+    hidden: int
+    heads: int
+    ff: int
+    dropout_rate: float = 0.1
+    attention_fn: Callable = dense_attention
+
+    @nn.compact
+    def __call__(self, x, bias, deterministic: bool):
+        y = MultiHeadAttention(
+            self.hidden, self.heads, self.dropout_rate, self.attention_fn,
+            name="attention",
+        )(x, bias, deterministic)
+        y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
+        x = nn.LayerNorm(epsilon=1e-12, name="ln_attn")(x + y)
+        y = nn.Dense(self.ff, name="ff_in")(x)
+        y = nn.gelu(y)
+        y = nn.Dense(self.hidden, name="ff_out")(y)
+        y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
+        return nn.LayerNorm(epsilon=1e-12, name="ln_ff")(x + y)
+
+
+class BertEncoder(nn.Module):
+    """Token + position + segment embeddings, N transformer blocks."""
+
+    vocab_size: int
+    size: BertSize
+    max_len: int = 512
+    dropout_rate: float = 0.1
+    attention_fn: Callable = dense_attention
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 train: bool = True):
+        B, L = input_ids.shape
+        h = nn.Embed(self.vocab_size, self.size.hidden, name="tok_emb")(input_ids)
+        pos = jnp.arange(L)[None, :]
+        h = h + nn.Embed(self.max_len, self.size.hidden, name="pos_emb")(pos)
+        if token_type_ids is not None:
+            h = h + nn.Embed(2, self.size.hidden, name="seg_emb")(token_type_ids)
+        h = nn.LayerNorm(epsilon=1e-12, name="ln_emb")(h)
+        h = nn.Dropout(self.dropout_rate)(h, deterministic=not train)
+        if attention_mask is None:
+            bias = None
+        else:
+            # additive mask: [B, 1, 1, L]; large negative on padding
+            bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e9).astype(
+                h.dtype
+            )
+        block = TransformerBlock
+        if self.remat:
+            block = nn.remat(TransformerBlock, static_argnums=(3,))
+        for i in range(self.size.num_layers):
+            h = block(
+                self.size.hidden, self.size.heads, self.size.ff,
+                self.dropout_rate, self.attention_fn, name=f"layer_{i}",
+            )(h, bias, not train)
+        return h
+
+
+class BertForSequenceClassification(nn.Module):
+    """Encoder + tanh pooler over [CLS] + classifier head (BERT seq-cls)."""
+
+    vocab_size: int = 30522
+    num_classes: int = 2
+    size_name: str = "base"
+    max_len: int = 512
+    dropout_rate: float = 0.1
+    attention_fn: Callable = dense_attention
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 train: bool = True):
+        size = BERT_SIZES[self.size_name]
+        h = BertEncoder(
+            self.vocab_size, size, self.max_len, self.dropout_rate,
+            self.attention_fn, self.remat, name="encoder",
+        )(input_ids, attention_mask, token_type_ids, train)
+        cls = nn.tanh(nn.Dense(size.hidden, name="pooler")(h[:, 0]))
+        cls = nn.Dropout(self.dropout_rate)(cls, deterministic=not train)
+        return nn.Dense(self.num_classes, name="classifier")(cls)
+
+
+BertBase = partial(BertForSequenceClassification, size_name="base")
+BertTiny = partial(BertForSequenceClassification, size_name="tiny")

@@ -1,0 +1,191 @@
+"""Native (C++) host-runtime components.
+
+The compute path of this framework is JAX/XLA/Pallas; the host runtime around
+it is native where the reference's is: the reference leans on torch's C++
+DataLoader machinery for its input pipeline (SURVEY.md §2.6 #24 / L0 native
+deps).  Here ``batcher.cpp`` provides a GIL-free thread-pool for the
+memory-bound host batching jobs (row gather, fused uint8→f32 normalize,
+ragged gather+pad), bound via ctypes (no pybind11 in the build image).
+
+The shared library is compiled on first use (g++, ~1s) and cached next to
+the source; environments without a toolchain fall back to numpy with the same
+API (``NativeBatcher.available`` tells you which path is active).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "batcher.cpp")
+_LIB = os.path.join(_HERE, "libstoke_batcher.so")
+_BUILD_LOCK = threading.Lock()
+_LIB_HANDLE: Optional[ctypes.CDLL] = None
+_BUILD_FAILED = False
+
+
+def _build_library() -> Optional[str]:
+    """Compile batcher.cpp → libstoke_batcher.so (idempotent, cached)."""
+    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        return _LIB
+    try:
+        subprocess.run(
+            [
+                "g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
+                _SRC, "-o", _LIB + ".tmp",
+            ],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(_LIB + ".tmp", _LIB)
+        return _LIB
+    except (subprocess.SubprocessError, OSError, FileNotFoundError):
+        return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB_HANDLE, _BUILD_FAILED
+    if _LIB_HANDLE is not None or _BUILD_FAILED:
+        return _LIB_HANDLE
+    with _BUILD_LOCK:
+        if _LIB_HANDLE is not None or _BUILD_FAILED:
+            return _LIB_HANDLE
+        path = _build_library()
+        if path is None:
+            _BUILD_FAILED = True
+            return None
+        lib = ctypes.CDLL(path)
+        lib.stoke_pool_new.restype = ctypes.c_void_p
+        lib.stoke_pool_new.argtypes = [ctypes.c_int]
+        lib.stoke_pool_free.argtypes = [ctypes.c_void_p]
+        lib.stoke_pool_size.restype = ctypes.c_int
+        lib.stoke_pool_size.argtypes = [ctypes.c_void_p]
+        lib.stoke_gather_rows.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+        ]
+        lib.stoke_u8_to_f32_norm.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p,
+        ]
+        lib.stoke_gather_pad_i32.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        _LIB_HANDLE = lib
+        return lib
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+class NativeBatcher:
+    """Thread-pool batch assembler with numpy fallback.
+
+    Args:
+        n_threads: worker threads (default: cpu count, capped at 8 — host
+            batching saturates memory bandwidth quickly).
+    """
+
+    def __init__(self, n_threads: Optional[int] = None):
+        lib = _load()
+        self._lib = lib
+        n = n_threads or min(os.cpu_count() or 1, 8)
+        self._pool = lib.stoke_pool_new(n) if lib else None
+
+    @property
+    def available(self) -> bool:
+        """True when the C++ path is active (False = numpy fallback)."""
+        return self._pool is not None
+
+    def __del__(self):
+        if getattr(self, "_pool", None) and self._lib:
+            self._lib.stoke_pool_free(self._pool)
+            self._pool = None
+
+    def gather_rows(self, src: np.ndarray, idx: Sequence[int]) -> np.ndarray:
+        """out[i] = src[idx[i]] — the sampler→batch gather."""
+        idx_arr = np.ascontiguousarray(idx, np.int64)
+        src = np.ascontiguousarray(src)
+        out = np.empty((len(idx_arr),) + src.shape[1:], src.dtype)
+        if not self.available or src.nbytes == 0:
+            np.take(src, idx_arr, axis=0, out=out)
+            return out
+        row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+        self._lib.stoke_gather_rows(
+            self._pool, _ptr(src), _ptr(idx_arr), len(idx_arr), row_bytes, _ptr(out)
+        )
+        return out
+
+    def u8_to_f32_norm(
+        self,
+        src: np.ndarray,
+        mean: Sequence[float],
+        std: Sequence[float],
+    ) -> np.ndarray:
+        """Fused uint8→float32 ``(x/255 - mean)/std`` over a channels-last
+        array (the CIFAR/ImageNet preprocessing hot path)."""
+        src = np.ascontiguousarray(src, np.uint8)
+        channels = src.shape[-1]
+        mean_a = np.ascontiguousarray(mean, np.float32)
+        std_a = np.ascontiguousarray(std, np.float32)
+        if mean_a.size != channels or std_a.size != channels:
+            raise ValueError("mean/std must have one entry per channel")
+        out = np.empty(src.shape, np.float32)
+        if not self.available:
+            out[:] = (src.astype(np.float32) / 255.0 - mean_a) / std_a
+            return out
+        self._lib.stoke_u8_to_f32_norm(
+            self._pool, _ptr(src), src.size, _ptr(mean_a), _ptr(std_a),
+            channels, _ptr(out),
+        )
+        return out
+
+    def gather_pad(
+        self,
+        ragged: np.ndarray,
+        offsets: np.ndarray,
+        lengths: np.ndarray,
+        idx: Sequence[int],
+        max_len: Optional[int] = None,
+        pad_multiple: int = 1,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Batch variable-length int32 sequences from a ragged buffer into a
+        zero-padded [n, max_len] matrix + 0/1 mask (the BERT bucketed-sampler
+        collate in one native call)."""
+        idx_arr = np.ascontiguousarray(idx, np.int64)
+        lengths = np.ascontiguousarray(lengths, np.int32)
+        offsets = np.ascontiguousarray(offsets, np.int64)
+        ragged = np.ascontiguousarray(ragged, np.int32)
+        if max_len is None:
+            max_len = int(lengths[idx_arr].max()) if len(idx_arr) else 0
+        if pad_multiple > 1:
+            max_len = ((max_len + pad_multiple - 1) // pad_multiple) * pad_multiple
+        out = np.empty((len(idx_arr), max_len), np.int32)
+        mask = np.empty((len(idx_arr), max_len), np.int32)
+        if not self.available:
+            for i, r in enumerate(idx_arr):
+                L = min(int(lengths[r]), max_len)
+                row = ragged[offsets[r] : offsets[r] + L]
+                out[i, :L] = row
+                out[i, L:] = 0
+                mask[i, :L] = 1
+                mask[i, L:] = 0
+            return out, mask
+        self._lib.stoke_gather_pad_i32(
+            self._pool, _ptr(ragged), _ptr(offsets), _ptr(lengths),
+            _ptr(idx_arr), len(idx_arr), max_len, _ptr(out), _ptr(mask),
+        )
+        return out, mask
+
+
+__all__ = ["NativeBatcher"]

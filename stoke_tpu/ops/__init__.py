@@ -1,0 +1,19 @@
+"""TPU ops: sequence-parallel attention (ring / Ulysses) and future pallas
+kernels.  The reference has NO model-level long-context support (SURVEY.md
+§2.8: "no sequence/context parallelism, no ring attention, no Ulysses") —
+only the data-level BucketedDistributedSampler; these ops are capability
+upside of the TPU build, designed in from the start."""
+
+from stoke_tpu.ops.attention import (
+    make_ring_attention,
+    make_ulysses_attention,
+    ring_attention,
+    ulysses_attention,
+)
+
+__all__ = [
+    "make_ring_attention",
+    "make_ulysses_attention",
+    "ring_attention",
+    "ulysses_attention",
+]

@@ -1,0 +1,259 @@
+"""Sequence-parallel attention: ring attention and Ulysses all-to-all.
+
+Long-context support the reference does not have (SURVEY.md §2.8).  Both
+transforms shard the SEQUENCE dimension over a mesh axis so context length
+scales with the number of devices; both are drop-in ``attention_fn``s for
+``stoke_tpu.models.bert`` (same signature as ``dense_attention``).
+
+- **Ring attention** (arxiv 2310.01889 pattern): Q stays put; K/V blocks
+  rotate around the mesh axis via ``lax.ppermute`` while a flash-style
+  online-softmax accumulator (running max ``m``, normalizer ``l``, weighted
+  sum ``o``) folds in one K/V block per hop.  Peak memory per device is
+  O(L_shard²) instead of O(L²), and the ppermute rides ICI neighbor links —
+  the topology's cheapest collective.
+
+- **Ulysses** (DeepSpeed-Ulysses pattern, arxiv 2309.14509): one
+  ``all_to_all`` re-shards [B, H, L/n, D] → [B, H/n, L, D] (heads sharded,
+  sequence gathered), runs ordinary dense attention locally, and a second
+  ``all_to_all`` restores sequence sharding.  Cheaper collectives for
+  moderate L; requires heads divisible by the axis size.
+
+Both are written against ``shard_map`` (explicit per-shard code + explicit
+collectives) and compose with the jit-GSPMD data-parallel engine: the mesh
+carries ("data", "seq") axes and batch arrays are sharded over both.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.4.35 exposes shard_map at top level
+    from jax import shard_map as _shard_map_fn
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=False):
+        return _shard_map_fn(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=False):
+        return _shard_map_old(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )
+
+_NEG_INF = -1e30
+
+
+def _online_softmax_block(o, m, l, scores, v):
+    """Fold one [.., Lq, Lk_blk] score block into the flash accumulator."""
+    m_blk = jnp.max(scores, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    # correction for previously accumulated blocks
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])
+    # fully-masked blocks: exp(-inf - (-inf)) would be 1; force true zeros
+    p = jnp.where(scores > _NEG_INF * 0.5, p, 0.0)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(p.dtype)
+    )
+    return o_new, m_new, l_new
+
+
+def _ring_shard(q, k, v, kmask, *, axis_name, causal, scale):
+    """Per-shard ring attention body (runs inside shard_map).
+
+    q: [B, H, Lq, D] (this device's query block, stays resident)
+    k, v: [B, H, Lk, D] (rotating blocks)
+    kmask: [B, Lk] 0/1 key-validity (rotates with k/v), or None
+    """
+    size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    qf = q.astype(jnp.float32)
+    scale = jnp.float32(scale)
+
+    q_pos = my_idx * Lq + jnp.arange(Lq)  # global query positions
+
+    o0 = jnp.zeros((B, H, Lq, D), jnp.float32)
+    m0 = jnp.full((B, H, Lq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Lq), jnp.float32)
+    perm = [(i, (i + 1) % size) for i in range(size)]
+
+    def body(step, carry):
+        o, m, l, k, v, kmask = carry
+        # which shard's K/V do we currently hold?
+        src = (my_idx - step) % size
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qf, k.astype(jnp.float32)) * scale
+        if kmask is not None:
+            scores = jnp.where(kmask[:, None, None, :] > 0, scores, _NEG_INF)
+        if causal:
+            k_pos = src * Lk + jnp.arange(Lk)
+            scores = jnp.where(
+                q_pos[:, None] >= k_pos[None, :], scores, _NEG_INF
+            )
+        o, m, l = _online_softmax_block(o, m, l, scores, v)
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        if kmask is not None:
+            kmask = lax.ppermute(kmask, axis_name, perm)
+        return o, m, l, k, v, kmask
+
+    o, m, l, *_ = lax.fori_loop(0, size, body, (o0, m0, l0, k, v, kmask))
+    # fully-masked rows (all padding) have l == 0; emit zeros, not NaN
+    safe_l = jnp.where(l > 0, l, 1.0)
+    return (o / safe_l[..., None]).astype(q.dtype)
+
+
+def ring_attention(
+    q, k, v, kmask=None, *, mesh: Mesh, axis_name: str = "seq",
+    causal: bool = False, batch_axis: Optional[str] = "data",
+):
+    """Ring attention over sequence shards.
+
+    Args:
+        q, k, v: [B, H, L, D] logically-global arrays (sharded over
+            ``axis_name`` on the L dim and optionally ``batch_axis`` on B).
+        kmask: optional [B, L] key-validity mask (1 = attend).
+        mesh: the device mesh holding ``axis_name`` (and ``batch_axis``).
+        causal: apply a causal (autoregressive) mask using global positions.
+
+    Returns [B, H, L, D] with the same sharding as ``q``.
+    """
+    ba = batch_axis if batch_axis and batch_axis in mesh.axis_names else None
+    qkv_spec = P(ba, None, axis_name, None)
+    mask_spec = P(ba, axis_name)
+    body = functools.partial(
+        _ring_shard,
+        axis_name=axis_name,
+        causal=causal,
+        scale=1.0 / (q.shape[-1] ** 0.5),
+    )
+    if kmask is None:
+        fn = shard_map(
+            lambda q, k, v: body(q, k, v, None),
+            mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec), out_specs=qkv_spec,
+        )
+        return fn(q, k, v)
+    fn = shard_map(
+        lambda q, k, v, km: body(q, k, v, km),
+        mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec,
+    )
+    return fn(q, k, v, kmask)
+
+
+def _ulysses_shard(q, k, v, kmask, *, axis_name, causal, scale):
+    """Per-shard Ulysses body: all_to_all to head-sharding, dense attention,
+    all_to_all back.  q/k/v: [B, H, Ls, D] with H the FULL head count."""
+    size = lax.psum(1, axis_name)
+    # [B, H, Ls, D] -> [B, H/n, L, D]: split heads (axis 1), concat seq (axis 2)
+    qh = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    kh = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    vh = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    if kmask is not None:
+        km = lax.all_gather(kmask, axis_name, axis=1, tiled=True)  # [B, L]
+    L = qh.shape[2]
+    scores = (
+        jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
+                   kh.astype(jnp.float32)) * scale
+    )
+    if kmask is not None:
+        scores = jnp.where(km[:, None, None, :] > 0, scores, _NEG_INF)
+    if causal:
+        pos = jnp.arange(L)
+        scores = jnp.where(pos[:, None] >= pos[None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh.astype(jnp.float32))
+    # [B, H/n, L, D] -> [B, H, Ls, D]
+    out = lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(
+    q, k, v, kmask=None, *, mesh: Mesh, axis_name: str = "seq",
+    causal: bool = False, batch_axis: Optional[str] = "data",
+):
+    """DeepSpeed-Ulysses-style all-to-all sequence parallelism (head count
+    must be divisible by the mesh axis size).  Same contract as
+    :func:`ring_attention`."""
+    size = mesh.shape[axis_name]
+    if q.shape[1] % size != 0:
+        raise ValueError(
+            f"ulysses_attention: heads ({q.shape[1]}) not divisible by "
+            f"mesh axis '{axis_name}' size ({size})"
+        )
+    ba = batch_axis if batch_axis and batch_axis in mesh.axis_names else None
+    qkv_spec = P(ba, None, axis_name, None)
+    mask_spec = P(ba, axis_name)
+    body = functools.partial(
+        _ulysses_shard,
+        axis_name=axis_name,
+        causal=causal,
+        scale=1.0 / (q.shape[-1] ** 0.5),
+    )
+    if kmask is None:
+        fn = shard_map(
+            lambda q, k, v: body(q, k, v, None),
+            mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec), out_specs=qkv_spec,
+        )
+        return fn(q, k, v)
+    fn = shard_map(
+        lambda q, k, v, km: body(q, k, v, km),
+        mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec,
+    )
+    return fn(q, k, v, kmask)
+
+
+def _as_model_attention(impl, mesh, axis_name, batch_axis, causal):
+    """Adapt ring/ulysses to the ``dense_attention`` signature used by
+    stoke_tpu.models.bert (q/k/v [B,H,L,D] + additive bias)."""
+
+    def attention_fn(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
+                     deterministic=True):
+        if dropout_rate > 0.0 and not deterministic:
+            raise NotImplementedError(
+                "sequence-parallel attention does not support attention-prob "
+                "dropout; set attention dropout to 0 (residual dropout is fine)"
+            )
+        kmask = None
+        if bias is not None:
+            # recover the [B, L] key mask from the additive [B,1,1,L] bias
+            kmask = (bias[:, 0, 0, :] > -1e8).astype(jnp.int32)
+        return impl(
+            q, k, v, kmask, mesh=mesh, axis_name=axis_name,
+            causal=causal, batch_axis=batch_axis,
+        )
+
+    return attention_fn
+
+
+def make_ring_attention(
+    mesh: Mesh, axis_name: str = "seq", batch_axis: str = "data",
+    causal: bool = False,
+) -> Callable:
+    """Build a ring-attention ``attention_fn`` pluggable into
+    ``BertEncoder(attention_fn=...)``."""
+    return _as_model_attention(ring_attention, mesh, axis_name, batch_axis, causal)
+
+
+def make_ulysses_attention(
+    mesh: Mesh, axis_name: str = "seq", batch_axis: str = "data",
+    causal: bool = False,
+) -> Callable:
+    """Build a Ulysses ``attention_fn`` pluggable into
+    ``BertEncoder(attention_fn=...)``."""
+    return _as_model_attention(ulysses_attention, mesh, axis_name, batch_axis, causal)

@@ -1,5 +1,6 @@
 """Utility helpers (reference: stoke/utils.py:1-151, TPU-native re-design)."""
 
+from stoke_tpu.utils.init import init_module
 from stoke_tpu.utils.printing import unrolled_print, make_folder
 from stoke_tpu.utils.trees import (
     tree_count_params,
@@ -13,6 +14,7 @@ from stoke_tpu.utils.trees import (
 )
 
 __all__ = [
+    "init_module",
     "unrolled_print",
     "make_folder",
     "tree_count_params",

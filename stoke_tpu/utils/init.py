@@ -1,0 +1,27 @@
+"""Model initialization helpers.
+
+``flax.linen.Module.init`` run eagerly executes hundreds of small ops on the
+default backend; on a remote/tunneled TPU each op pays a round trip and init
+takes minutes.  :func:`init_module` runs the whole init as ONE compiled
+program on the host CPU — the facade then places the result onto the mesh
+according to the sharding rules, so no device ever holds more than its shard
+(plus the host copy)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def init_module(module, rng, *args, **kwargs) -> Any:
+    """Initialize a flax module's variables host-side in one compiled call.
+
+    Usage:
+        variables = init_module(model, jax.random.PRNGKey(0), dummy_batch,
+                                train=False)
+    """
+    cpu = jax.devices("cpu")[0]
+    rng = jax.device_put(rng, cpu)
+    with jax.default_device(cpu):
+        return jax.jit(lambda r: module.init(r, *args, **kwargs))(rng)

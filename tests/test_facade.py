@@ -304,3 +304,88 @@ def test_reset(rng):
 
 def test_barrier_noop_single_process():
     make_stoke().barrier()  # must not raise
+
+
+# ------------------------- fused train_step ------------------------------- #
+
+
+def test_train_step_matches_four_call(rng):
+    """The fused fast path must be numerically identical to the 4-call
+    contract (same compiled math, fewer dispatches)."""
+    batches = [batch(rng) for _ in range(6)]
+    s1 = make_stoke(grad_accum=2)
+    for x, y in batches:
+        out = s1.model(x)
+        s1.backward(s1.loss(out, y))
+        s1.step()
+    s2 = make_stoke(grad_accum=2)
+    for x, y in batches:
+        s2.train_step(x, y)
+    np.testing.assert_allclose(
+        np.asarray(s1.params["w"]), np.asarray(s2.params["w"]), rtol=1e-6
+    )
+    assert s1.optimizer_steps == s2.optimizer_steps == 3
+    assert s1.backward_steps == s2.backward_steps == 6
+    assert s1.ema_loss == pytest.approx(s2.ema_loss, rel=1e-5)
+
+
+def test_train_step_multi_input_model(rng):
+    def model2(params, x, bias):
+        return x @ params["w"] + bias
+
+    s = make_stoke(model=model2)
+    x, y = batch(rng)
+    bias = np.ones((2,), np.float32)
+    l = s.train_step((x, bias), y)
+    assert float(l) > 0
+    assert s.optimizer_steps == 1
+
+
+def test_train_step_eval_mode_raises(rng):
+    s = make_stoke().eval()
+    x, y = batch(rng)
+    with pytest.raises(RuntimeError):
+        s.train_step(x, y)
+
+
+def test_train_step_fp16_skips_on_overflow(rng):
+    def exploding(out, y):
+        return jnp.mean((out - y) ** 2) * 1e30
+
+    s = make_stoke(loss=exploding, precision="fp16")
+    x, y = batch(rng)
+    w_before = np.asarray(s.params["w"]).copy()
+    s.train_step(x, y)
+    np.testing.assert_array_equal(w_before, np.asarray(s.params["w"]))
+    assert s.skipped_optimizer_steps == 1.0
+
+
+# ------------------------- profiling -------------------------------------- #
+
+
+def test_profile_trace_noop_without_dir(rng):
+    s = make_stoke()
+    with s.profile_trace():
+        pass  # must not raise
+
+
+def test_profile_trace_writes(tmp_path, rng):
+    from stoke_tpu import ProfilerConfig
+
+    s = make_stoke(configs=[ProfilerConfig(trace_dir=str(tmp_path))])
+    x, y = batch(rng)
+    with s.profile_trace():
+        s.train_step(x, y)
+    import os
+
+    assert any(os.scandir(str(tmp_path)))  # trace files exist
+
+
+def test_estimate_step_flops(rng):
+    s = make_stoke()
+    x, y = batch(rng)
+    flops = s.estimate_step_flops(x, y)
+    # CPU backend may not report cost analysis; when it does, the estimate
+    # must at least cover the forward matmul FLOPs
+    if flops is not None:
+        assert flops >= 2 * 8 * 4 * 2
