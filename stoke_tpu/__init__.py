@@ -19,6 +19,7 @@ from stoke_tpu.configs import (
     FSDPConfig,
     LossReduction,
     MeshConfig,
+    OffloadOptimizerConfig,
     OSSConfig,
     ParamNormalize,
     PrecisionConfig,
@@ -68,6 +69,7 @@ __all__ = [
     "OSSConfig",
     "SDDPConfig",
     "FSDPConfig",
+    "OffloadOptimizerConfig",
     "ActivationCheckpointingConfig",
     "CheckpointConfig",
     "ProfilerConfig",

@@ -334,6 +334,30 @@ class FSDPConfig:
 
 
 @dataclass
+class OffloadOptimizerConfig:
+    """Optimizer-state offload to host memory (ZeRO-offload equivalent).
+
+    Reference: DeepspeedOffloadOptimizerConfig (configs.py:309-343) moves
+    optimizer state to CPU/NVMe.  TPU-native: optimizer-state shardings get
+    ``memory_kind="pinned_host"`` so XLA keeps the state in host RAM and
+    streams it through HBM during the (bandwidth-bound) update — trading
+    update speed for HBM headroom.  NVMe/aio tiers
+    (DeepspeedAIOConfig, configs.py:192-219) have no TPU equivalent; host
+    memory is the offload tier.
+
+    Attributes:
+        pin_memory: parity field (configs.py:330); host staging is always
+            pinned on TPU runtimes.
+        fallback_to_device: if the runtime lacks host-memory-kind support
+            (e.g. the CPU simulator), warn and keep state on device instead
+            of failing.
+    """
+
+    pin_memory: bool = True
+    fallback_to_device: bool = True
+
+
+@dataclass
 class ActivationCheckpointingConfig:
     """Rematerialization policy mapped onto ``jax.checkpoint``.
 
@@ -428,6 +452,7 @@ ALL_CONFIG_CLASSES: Tuple[type, ...] = (
     OSSConfig,
     SDDPConfig,
     FSDPConfig,
+    OffloadOptimizerConfig,
     ActivationCheckpointingConfig,
     CheckpointConfig,
     ProfilerConfig,

@@ -379,6 +379,7 @@ class StepEngine:
         grad_clip,
         rules: Optional[ShardingRules],
         remat: Optional[ActivationCheckpointingConfig] = None,
+        offload_optimizer: Optional[Any] = None,
     ):
         self.adapter = adapter
         self.loss_fn = loss_fn
@@ -389,6 +390,7 @@ class StepEngine:
         self.grad_clip = grad_clip
         self.rules = rules
         self.remat = remat
+        self.offload_optimizer = offload_optimizer
         self._accum_cache: Dict[Any, Callable] = {}
         self._fwd_cache: Dict[Any, Callable] = {}
         self._loss_cache: Dict[Any, Callable] = {}
@@ -419,8 +421,36 @@ class StepEngine:
         self._var_shardings = {"params": params_sh, **other_sh}
         self._grad_shardings = self.rules.grad_shardings(variables["params"])
         self._opt_shardings = self.rules.opt_shardings(opt_state_shapes)
+        if self.offload_optimizer is not None:
+            self._opt_shardings = self._offload_shardings(self._opt_shardings)
         self._repl = self.rules.replicated()
         return jax.device_put(variables, self._var_shardings)
+
+    def _offload_shardings(self, opt_shardings):
+        """Re-target optimizer-state shardings to host memory
+        (``memory_kind="pinned_host"``) — the ZeRO-offload equivalent
+        (reference DeepspeedOffloadOptimizerConfig, configs.py:309-343).
+        Falls back to device placement where the runtime lacks host memory
+        kinds (e.g. the CPU simulator) when the config allows."""
+        import warnings
+
+        from jax.sharding import NamedSharding as _NS
+
+        def _to_host(sh):
+            return _NS(sh.mesh, sh.spec, memory_kind="pinned_host")
+
+        try:
+            probe = jax.tree_util.tree_leaves(opt_shardings)[0]
+            jax.device_put(jnp.zeros((1,), jnp.float32), _to_host(probe))
+            return jax.tree_util.tree_map(_to_host, opt_shardings)
+        except Exception:
+            if self.offload_optimizer.fallback_to_device:
+                warnings.warn(
+                    "Stoke -- optimizer-state host offload unsupported on "
+                    "this runtime; keeping state on device"
+                )
+                return opt_shardings
+            raise
 
     def init_grad_buffer(self, variables):
         """Zero accumulation buffer, sharded per the tier's grad rule

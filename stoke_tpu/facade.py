@@ -58,6 +58,22 @@ from stoke_tpu.utils.trees import tree_count_params
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 
+def _timed(phase: str):
+    """Method decorator feeding the wall-clock breakdown (no-op overhead of
+    one null-context when disabled)."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            with self._clock(phase):
+                return fn(self, *args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
 class Stoke:
     """Declarative training-context facade (reference stoke/stoke.py:49-1466).
 
@@ -181,6 +197,7 @@ class Stoke:
             grad_clip=st.grad_clip,
             rules=self._rules,
             remat=st.activation_checkpointing_config,
+            offload_optimizer=st.offload_optimizer_config,
         )
         if self._rules is not None:
             opt_shapes = jax.eval_shape(self._optimizer.init, variables["params"])
@@ -189,8 +206,11 @@ class Stoke:
             self._opt_state = self._engine.init_opt_state(variables)
         else:
             self._variables = jax.device_put(variables, self._device)
+            opt_target = self._device
+            if st.offload_optimizer_config is not None:
+                opt_target = self._single_device_offload_target()
             self._opt_state = jax.device_put(
-                self._optimizer.init(self._variables["params"]), self._device
+                self._optimizer.init(self._variables["params"]), opt_target
             )
         self._grad_buf = self._engine.init_grad_buffer(self._variables)
         self._scaler_state = self._place_scalar_tree(
@@ -216,6 +236,12 @@ class Stoke:
         self._stashed_model_call: Optional[tuple] = None
         self._pending: Optional[tuple] = None  # (new_grad_buf, token)
 
+        # ----- wall-clock breakdown (reference wall_clock_breakdown,
+        #       configs.py:540; host-side dispatch times — device work is
+        #       async, use profile_trace() for device timelines) -----
+        self._wall_clock: Dict[str, float] = {}
+        self._wall_clock_enabled = st.profiler_config.wall_clock_breakdown
+
         # ----- post-init status (reference stoke.py:245) -----
         world = self._mesh.size if self._mesh is not None else 1
         st.set_post_init_values(world, n_processes=jax.process_count())
@@ -225,6 +251,27 @@ class Stoke:
     # ------------------------------------------------------------------ #
     # placement helpers
     # ------------------------------------------------------------------ #
+
+    def _single_device_offload_target(self):
+        """Host-memory placement for single-device optimizer offload, with
+        the same probe/fallback policy as the mesh path."""
+        import warnings
+
+        from jax.sharding import SingleDeviceSharding
+
+        target = SingleDeviceSharding(self._device, memory_kind="pinned_host")
+        try:
+            jax.device_put(jnp.zeros((1,), jnp.float32), target)
+            return target
+        except Exception:
+            cfg = self._status_obj.offload_optimizer_config
+            if cfg is not None and cfg.fallback_to_device:
+                warnings.warn(
+                    "Stoke -- optimizer-state host offload unsupported on "
+                    "this runtime; keeping state on device"
+                )
+                return self._device
+            raise
 
     def _zero_scalar(self):
         return self._place_scalar_tree(jnp.float32(0.0))
@@ -282,6 +329,7 @@ class Stoke:
     # the 4-call contract
     # ------------------------------------------------------------------ #
 
+    @_timed("model")
     def model(self, *args, **kwargs):
         """Wrapped forward (reference stoke.py:853-869).
 
@@ -307,6 +355,7 @@ class Stoke:
         margs, mkwargs, _ = self._stashed_model_call
         return self._engine.train_fwd(self._variables, self._rng, margs, mkwargs)
 
+    @_timed("loss")
     def loss(self, *args, **kwargs):
         """Wrapped loss (reference stoke.py:872-912).
 
@@ -369,6 +418,7 @@ class Stoke:
             self._update_loss_tracking(report)
         return report
 
+    @_timed("backward")
     def backward(self, loss: Any = None) -> None:
         """Wrapped backward (reference stoke.py:960-988): commits the grads
         of the last ``loss()`` into the accumulation buffer and advances the
@@ -388,6 +438,7 @@ class Stoke:
         self._grad_accum_counter += 1
         self._backward_steps += 1
 
+    @_timed("step")
     def step(self) -> None:
         """Wrapped optimizer step (reference stoke.py:990-1040): at the
         accumulation boundary runs the compiled apply (unscale → finite-check
@@ -412,6 +463,7 @@ class Stoke:
         self._grad_accum_counter = 0
         self._reset_tracking_window()
 
+    @_timed("train_step")
     def train_step(
         self,
         model_args: Any,
@@ -636,6 +688,40 @@ class Stoke:
     # reference's DeepSpeed flops-profiler passthrough, configs.py:252-279)
     # ------------------------------------------------------------------ #
 
+    def _clock(self, phase: str):
+        """Accumulating host-side timer for the wall-clock breakdown."""
+        import contextlib
+
+        if not self._wall_clock_enabled:
+            return contextlib.nullcontext()
+
+        @contextlib.contextmanager
+        def _timer():
+            t0 = time.perf_counter()
+            try:
+                yield
+            finally:
+                self._wall_clock[phase] = self._wall_clock.get(phase, 0.0) + (
+                    time.perf_counter() - t0
+                )
+
+        return _timer()
+
+    @property
+    def wall_clock_breakdown(self) -> Dict[str, float]:
+        """Cumulative host seconds per facade phase (enable via
+        ``ProfilerConfig(wall_clock_breakdown=True)``; reference
+        configs.py:540).  Host dispatch time only — device execution is
+        asynchronous; use :meth:`profile_trace` for device timelines."""
+        return dict(self._wall_clock)
+
+    def print_wall_clock_breakdown(self) -> None:
+        total = sum(self._wall_clock.values()) or 1.0
+        for phase, secs in sorted(self._wall_clock.items(), key=lambda kv: -kv[1]):
+            self.print_on_devices(
+                f"wall_clock {phase}: {secs:.3f}s ({100 * secs / total:.1f}%)"
+            )
+
     def profile_trace(self, name: str = "stoke"):
         """Context manager capturing a ``jax.profiler`` trace (serves the
         TensorBoard profile plugin / xprof) when ``ProfilerConfig.trace_dir``
@@ -736,6 +822,7 @@ class Stoke:
     # save / load (reference stoke.py:1060-1142)
     # ------------------------------------------------------------------ #
 
+    @_timed("save")
     def save(
         self,
         path: str,
@@ -766,6 +853,7 @@ class Stoke:
             grad_buf=self._grad_buf if self._grad_accum_counter > 0 else None,
         )
 
+    @_timed("load")
     def load(
         self, path: str, tag: Optional[str] = None, name: str = "stoke"
     ) -> Dict[str, Any]:

@@ -107,7 +107,15 @@ class TransformerBlock(nn.Module):
 
 
 class BertEncoder(nn.Module):
-    """Token + position + segment embeddings, N transformer blocks."""
+    """Token + position + segment embeddings, N transformer blocks.
+
+    ``layer_drop_rate`` enables progressive layer drop — stochastic depth
+    with a linearly increasing drop probability over depth (layer i is kept
+    with probability ``1 - rate * (i+1)/N`` during training).  This is the
+    TPU-native counterpart of the reference's DeepSpeed PLD passthrough
+    (configs.py:375-388, distributed.py:876-896); needs the ``layer_drop``
+    rng stream (pass ``model_rng_keys=("dropout", "layer_drop")`` to Stoke).
+    """
 
     vocab_size: int
     size: BertSize
@@ -115,6 +123,7 @@ class BertEncoder(nn.Module):
     dropout_rate: float = 0.1
     attention_fn: Callable = dense_attention
     remat: bool = False
+    layer_drop_rate: float = 0.0
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
@@ -137,11 +146,22 @@ class BertEncoder(nn.Module):
         block = TransformerBlock
         if self.remat:
             block = nn.remat(TransformerBlock, static_argnums=(3,))
+        drop_keys = None
+        if self.layer_drop_rate > 0.0 and train:
+            drop_keys = jax.random.split(
+                self.make_rng("layer_drop"), self.size.num_layers
+            )
         for i in range(self.size.num_layers):
-            h = block(
+            h_new = block(
                 self.size.hidden, self.size.heads, self.size.ff,
                 self.dropout_rate, self.attention_fn, name=f"layer_{i}",
             )(h, bias, not train)
+            if drop_keys is not None:
+                keep_p = 1.0 - self.layer_drop_rate * (i + 1) / self.size.num_layers
+                keep = jax.random.bernoulli(drop_keys[i], keep_p)
+                h = jnp.where(keep, h_new, h)
+            else:
+                h = h_new
         return h
 
 
@@ -155,6 +175,7 @@ class BertForSequenceClassification(nn.Module):
     dropout_rate: float = 0.1
     attention_fn: Callable = dense_attention
     remat: bool = False
+    layer_drop_rate: float = 0.0
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
@@ -162,7 +183,8 @@ class BertForSequenceClassification(nn.Module):
         size = BERT_SIZES[self.size_name]
         h = BertEncoder(
             self.vocab_size, size, self.max_len, self.dropout_rate,
-            self.attention_fn, self.remat, name="encoder",
+            self.attention_fn, self.remat, self.layer_drop_rate,
+            name="encoder",
         )(input_ids, attention_mask, token_type_ids, train)
         cls = nn.tanh(nn.Dense(size.hidden, name="pooler")(h[:, 0]))
         cls = nn.Dropout(self.dropout_rate)(cls, deterministic=not train)

@@ -34,6 +34,7 @@ from stoke_tpu.configs import (
     DistributedOptions,
     FSDPConfig,
     MeshConfig,
+    OffloadOptimizerConfig,
     OSSConfig,
     PrecisionConfig,
     PrecisionOptions,
@@ -361,6 +362,12 @@ class StokeStatus:
     @property
     def fsdp_config(self) -> FSDPConfig:
         return self._get_or_default(FSDPConfig)
+
+    @property
+    def offload_optimizer_config(self):
+        """None unless explicitly supplied (offload is opt-in, reference
+        configs.py:309-343)."""
+        return self._configs.get("OffloadOptimizerConfig")
 
     @property
     def activation_checkpointing_config(self) -> Optional[ActivationCheckpointingConfig]:

@@ -381,6 +381,43 @@ def test_profile_trace_writes(tmp_path, rng):
     assert any(os.scandir(str(tmp_path)))  # trace files exist
 
 
+def test_wall_clock_breakdown(rng):
+    from stoke_tpu import ProfilerConfig
+
+    s = make_stoke(configs=[ProfilerConfig(wall_clock_breakdown=True)])
+    x, y = batch(rng)
+    s.backward(s.loss(s.model(x), y))
+    s.step()
+    s.train_step(x, y)
+    bd = s.wall_clock_breakdown
+    assert {"model", "loss", "backward", "step", "train_step"} <= set(bd)
+    assert bd["loss"] > 0
+    s.print_wall_clock_breakdown()
+
+
+def test_wall_clock_disabled_by_default(rng):
+    s = make_stoke()
+    x, y = batch(rng)
+    s.train_step(x, y)
+    assert s.wall_clock_breakdown == {}
+
+
+def test_offload_optimizer_fallback_trains(rng):
+    """On runtimes without host memory kinds the offload config must fall
+    back to device placement with a warning and still train."""
+    import warnings
+
+    from stoke_tpu import OffloadOptimizerConfig
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s = make_stoke(configs=[OffloadOptimizerConfig()])
+    for _ in range(5):
+        x, y = batch(rng)
+        s.train_step(x, y)
+    assert s.optimizer_steps == 5
+
+
 def test_estimate_step_flops(rng):
     s = make_stoke()
     x, y = batch(rng)
