@@ -69,8 +69,9 @@ def pad_collate(samples):
     lengths) and emit input_ids / attention_mask / labels."""
     seqs, labels = zip(*samples)
     max_len = max(len(s) for s in seqs)
-    # round up to a multiple of 16 to limit XLA recompilation across batches
-    max_len = ((max_len + 15) // 16) * 16
+    # round up to a multiple of 32: limits XLA recompilation across batches
+    # and satisfies the flash/ring block and shard divisibility constraints
+    max_len = ((max_len + 31) // 32) * 32
     ids = np.zeros((len(seqs), max_len), np.int32)
     mask = np.zeros((len(seqs), max_len), np.int32)
     for i, s in enumerate(seqs):
@@ -92,11 +93,45 @@ def main():
     ap.add_argument("--n-samples", type=int, default=4096)
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--fsdp", action="store_true")
+    ap.add_argument(
+        "--attention", default="dense",
+        choices=["dense", "flash", "ring", "ulysses"],
+        help="dense softmax, pallas flash kernel, or sequence-parallel "
+        "ring/Ulysses over a mesh seq axis",
+    )
+    ap.add_argument("--seq-par", type=int, default=2,
+                    help="mesh seq-axis size for ring/ulysses")
     args = ap.parse_args()
 
+    attention_fn = None
+    mesh_cfgs = []
+    if args.attention == "flash":
+        from stoke_tpu.ops import make_flash_attention
+
+        attention_fn = make_flash_attention(block_q=32, block_k=32)
+    elif args.attention in ("ring", "ulysses"):
+        from stoke_tpu.configs import DeviceOptions, MeshConfig
+        from stoke_tpu.ops import make_ring_attention, make_ulysses_attention
+        from stoke_tpu.parallel import build_mesh
+
+        mesh_cfg = MeshConfig(axes=("data", "seq"), shape=(-1, args.seq_par))
+        mesh = build_mesh(mesh_cfg, DeviceOptions(args.device), True)
+        maker = (
+            make_ring_attention if args.attention == "ring"
+            else make_ulysses_attention
+        )
+        attention_fn = maker(mesh, "seq", "data")
+        mesh_cfgs = [mesh_cfg]
+        if args.distributed is None:
+            args.distributed = "dp"
+
     ds = SyntheticSeqClsDataset(n=args.n_samples)
+    model_kwargs = {}
+    if attention_fn is not None:
+        model_kwargs = {"attention_fn": attention_fn, "dropout_rate": 0.0}
     model = BertForSequenceClassification(
-        vocab_size=1000, num_classes=2, size_name=args.size, max_len=256
+        vocab_size=1000, num_classes=2, size_name=args.size, max_len=256,
+        **model_kwargs,
     )
     from stoke_tpu import init_module
 
@@ -124,6 +159,7 @@ def main():
         distributed=args.distributed,
         precision=args.precision,
         fsdp=args.fsdp,
+        configs=mesh_cfgs,
         model_train_kwargs={"train": True},
         model_eval_kwargs={"train": False},
     )

@@ -10,10 +10,13 @@ from stoke_tpu.ops.attention import (
     ring_attention,
     ulysses_attention,
 )
+from stoke_tpu.ops.flash_attention import flash_attention, make_flash_attention
 
 __all__ = [
     "make_ring_attention",
     "make_ulysses_attention",
     "ring_attention",
     "ulysses_attention",
+    "flash_attention",
+    "make_flash_attention",
 ]

@@ -53,6 +53,22 @@ except ImportError:  # pragma: no cover - older jax
 _NEG_INF = -1e30
 
 
+def _resolve_batch_axis(q, mesh, axis_name, batch_axis) -> Optional[str]:
+    """Shard the batch over ``batch_axis`` when possible; replicate when the
+    axis is absent or the batch is not divisible (e.g. tiny init-tracing
+    batches).  The sequence axis is mandatory — raise if L doesn't divide."""
+    if q.shape[2] % mesh.shape[axis_name] != 0:
+        raise ValueError(
+            f"sequence length {q.shape[2]} not divisible by mesh axis "
+            f"'{axis_name}' size {mesh.shape[axis_name]}; pad the sequence"
+        )
+    if not batch_axis or batch_axis not in mesh.axis_names:
+        return None
+    if q.shape[0] % mesh.shape[batch_axis] != 0:
+        return None
+    return batch_axis
+
+
 def _online_softmax_block(o, m, l, scores, v):
     """Fold one [.., Lq, Lk_blk] score block into the flash accumulator."""
     m_blk = jnp.max(scores, axis=-1)
@@ -130,7 +146,7 @@ def ring_attention(
 
     Returns [B, H, L, D] with the same sharding as ``q``.
     """
-    ba = batch_axis if batch_axis and batch_axis in mesh.axis_names else None
+    ba = _resolve_batch_axis(q, mesh, axis_name, batch_axis)
     qkv_spec = P(ba, None, axis_name, None)
     mask_spec = P(ba, axis_name)
     body = functools.partial(
@@ -194,7 +210,7 @@ def ulysses_attention(
             f"ulysses_attention: heads ({q.shape[1]}) not divisible by "
             f"mesh axis '{axis_name}' size ({size})"
         )
-    ba = batch_axis if batch_axis and batch_axis in mesh.axis_names else None
+    ba = _resolve_batch_axis(q, mesh, axis_name, batch_axis)
     qkv_spec = P(ba, None, axis_name, None)
     mask_spec = P(ba, axis_name)
     body = functools.partial(

@@ -1,0 +1,421 @@
+"""Pallas flash attention for TPU (forward + custom-VJP backward).
+
+The dense-attention hot path materializes the [L, L] score matrix in HBM;
+this kernel keeps score blocks in VMEM and streams K/V blocks through the
+MXU with the online-softmax recurrence, so attention memory is O(L·D) and
+the score traffic never leaves the chip (pallas_guide.md: HBM→VMEM→MXU).
+
+Layout: q/k/v are [BH, L, D] (batch×heads flattened outside).  The grid is
+(BH, q_blocks, k_blocks) with the k dimension innermost — on TPU the grid is
+executed sequentially per core, so VMEM scratch (the running max ``m``,
+normalizer ``l``, and output accumulator) persists across the k sweep of one
+q block (initialized at k==0, finalized at the last k).
+
+Backward implements the standard flash recurrence from the saved
+logsumexp rows: two kernels, one accumulating dQ over the k sweep and one
+accumulating dK/dV over the q sweep, both recomputing P blocks on-chip.
+
+Supports causal masking (upper-triangle k blocks are skipped entirely, not
+just masked) and a [B, L] key-padding mask.  ``interpret=True`` runs the
+same kernels through the pallas interpreter (used for CPU tests).
+
+Used via ``make_flash_attention()`` as a drop-in ``attention_fn`` for
+``stoke_tpu.models.bert`` — composable with the ring transform (ring for
+cross-device sequence sharding, flash for the on-chip block math).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _validate(q, k, v, mask, heads):
+    if q.ndim != 3:
+        raise ValueError(f"expected [BH, L, D] inputs, got {q.shape}")
+    if q.shape != k.shape or k.shape != v.shape:
+        raise ValueError("q/k/v shapes must match")
+    if mask is not None and mask.shape != (q.shape[0] // heads, q.shape[1]):
+        raise ValueError(
+            f"mask must be [B, L] = {(q.shape[0] // heads, q.shape[1])}, "
+            f"got {mask.shape}"
+        )
+
+
+# --------------------------------------------------------------------------- #
+# forward
+# --------------------------------------------------------------------------- #
+
+
+def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc, m_sc, l_sc, *, scale, causal, block_q, block_k, L):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    qi = pl.program_id(1)
+    run = True
+    if causal:
+        # a k block strictly above the diagonal contributes nothing
+        run = qi * block_q + block_q - 1 >= ki * block_k
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[0].astype(jnp.float32)
+        kb = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_k]
+        if mask_ref is not None:
+            valid = mask_ref[0] > 0  # [block_k]
+            s = jnp.where(valid[None, :], s, _NEG_INF)
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        m_prev = m_sc[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(s > _NEG_INF * 0.5, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)  # [block_q, 1]
+        l_sc[:, 0:1] = l_sc[:, 0:1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        m_sc[:, 0:1] = m_new
+        pv = jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc[:] = acc[:] * corr + pv
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_sc[:, 0:1]
+        safe_l = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = (acc[:] / safe_l).astype(o_ref.dtype)
+        # logsumexp rows for the backward pass; fully-masked rows get -inf
+        lse = m_sc[:, 0:1] + jnp.log(safe_l)
+        lse_ref[0] = jnp.where(l > 0, lse, _NEG_INF)[:, 0]
+
+
+def _flash_forward(q, k, v, mask, heads, scale, causal, block_q, block_k,
+                   interpret):
+    BH, L, D = q.shape
+    nq, nk = pl.cdiv(L, block_q), pl.cdiv(L, block_k)
+    kernel = functools.partial(
+        _fwd_kernel if mask is not None else
+        functools.partial(_fwd_kernel, None),
+        scale=scale, causal=causal, block_q=block_q, block_k=block_k, L=L,
+    )
+    in_specs = []
+    args = []
+    if mask is not None:
+        in_specs.append(
+            pl.BlockSpec((1, block_k), lambda bh, qi, ki: (bh // heads, ki))
+        )
+        args.append(mask)
+    in_specs += [
+        pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+    ]
+    args += [q, k, v]
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(BH, nq, nk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, L), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    return out, lse
+
+
+# --------------------------------------------------------------------------- #
+# backward
+# --------------------------------------------------------------------------- #
+
+
+def _recompute_p(q_ref, k_ref, lse_rows, mask_ref, qi, ki, *, scale, causal,
+                 block_q, block_k):
+    """Recompute the softmax block P from saved logsumexp rows."""
+    q = q_ref[0].astype(jnp.float32)
+    kb = k_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if mask_ref is not None:
+        s = jnp.where((mask_ref[0] > 0)[None, :], s, _NEG_INF)
+    if causal:
+        qpos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        kpos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+    p = jnp.exp(s - lse_rows[:, None])
+    return jnp.where(s > _NEG_INF * 0.5, p, 0.0)
+
+
+def _dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_acc, *, scale, causal, block_q, block_k):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = True
+    if causal:
+        run = qi * block_q + block_q - 1 >= ki * block_k
+
+    @pl.when(run)
+    def _block():
+        p = _recompute_p(
+            q_ref, k_ref, lse_ref[0], mask_ref, qi, ki,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        )
+        do = do_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, None])
+        dq_acc[:] += scale * jax.lax.dot_general(
+            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, block_q,
+                block_k):
+    qi = pl.program_id(2)  # innermost: sweep over q blocks
+    nq = pl.num_programs(2)
+    ki = pl.program_id(1)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        run = qi * block_q + block_q - 1 >= ki * block_k
+
+    @pl.when(run)
+    def _block():
+        p = _recompute_p(
+            q_ref, k_ref, lse_ref[0], mask_ref, qi, ki,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        )
+        do = do_ref[0].astype(jnp.float32)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, None])
+        dk_acc[:] += scale * jax.lax.dot_general(
+            ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(res, g, heads, scale, causal, block_q, block_k, interpret):
+    q, k, v, mask, out, lse = res
+    do = g
+    BH, L, D = q.shape
+    nq, nk = pl.cdiv(L, block_q), pl.cdiv(L, block_k)
+    # delta_i = rowsum(dO_i * O_i)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    def specs(maskless_first, grid_inner_is_k):
+        idx_q = (lambda bh, a, b: (bh, a, 0)) if grid_inner_is_k else (
+            lambda bh, a, b: (bh, b, 0))
+        idx_k = (lambda bh, a, b: (bh, b, 0)) if grid_inner_is_k else (
+            lambda bh, a, b: (bh, a, 0))
+        idx_qrow = (lambda bh, a, b: (bh, a)) if grid_inner_is_k else (
+            lambda bh, a, b: (bh, b))
+        idx_krow = (lambda bh, a, b: (bh, b)) if grid_inner_is_k else (
+            lambda bh, a, b: (bh, a))
+        sp = []
+        if mask is not None:
+            sp.append(pl.BlockSpec((1, block_k), lambda bh, a, b: (
+                bh // heads, b if grid_inner_is_k else a)))
+        sp += [
+            pl.BlockSpec((1, block_q, D), idx_q),   # q
+            pl.BlockSpec((1, block_k, D), idx_k),   # k
+            pl.BlockSpec((1, block_k, D), idx_k),   # v
+            pl.BlockSpec((1, block_q, D), idx_q),   # do
+            pl.BlockSpec((1, block_q), idx_qrow),   # lse
+            pl.BlockSpec((1, block_q), idx_qrow),   # delta
+        ]
+        return sp
+
+    args = ([mask] if mask is not None else []) + [q, k, v, do, lse, delta]
+
+    dq_kernel = functools.partial(
+        _dq_kernel if mask is not None else functools.partial(_dq_kernel, None),
+        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(BH, nq, nk),
+        in_specs=specs(mask is None, grid_inner_is_k=True),
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(*args)
+
+    dkv_kernel = functools.partial(
+        _dkv_kernel if mask is not None else functools.partial(_dkv_kernel, None),
+        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(BH, nk, nq),
+        in_specs=specs(mask is None, grid_inner_is_k=False),
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, L, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, L, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    return dq, dk, dv, None
+
+
+# --------------------------------------------------------------------------- #
+# public API
+# --------------------------------------------------------------------------- #
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9)
+)
+def _flash(q, k, v, mask, heads, scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_forward(
+        q, k, v, mask, heads, scale, causal, block_q, block_k, interpret
+    )
+    return out
+
+
+def _flash_fwd_rule(q, k, v, mask, heads, scale, causal, block_q, block_k,
+                    interpret):
+    out, lse = _flash_forward(
+        q, k, v, mask, heads, scale, causal, block_q, block_k, interpret
+    )
+    return out, (q, k, v, mask, out, lse)
+
+
+def _flash_bwd_rule(heads, scale, causal, block_q, block_k, interpret, res, g):
+    return _flash_backward(
+        res, g, heads, scale, causal, block_q, block_k, interpret
+    )
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(
+    q, k, v, mask=None, *, causal: bool = False,
+    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
+    interpret: Optional[bool] = None,
+):
+    """Flash attention on [B, H, L, D] inputs with optional [B, L] key mask.
+
+    ``interpret=None`` auto-selects the pallas interpreter off-TPU (tests).
+    L must be divisible by the block sizes (block sizes are clamped to L).
+    """
+    B, H, L, D = q.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_q, block_k = min(block_q, L), min(block_k, L)
+    if L % block_q or L % block_k:
+        raise ValueError(
+            f"sequence length {L} must be divisible by block sizes "
+            f"({block_q}, {block_k})"
+        )
+    flat = lambda t: t.reshape(B * H, L, D)
+    out = _flash(
+        flat(q), flat(k), flat(v), mask, H, 1.0 / (D**0.5), causal,
+        block_q, block_k, interpret,
+    )
+    return out.reshape(B, H, L, D)
+
+
+def make_flash_attention(
+    causal: bool = False, block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K, interpret: Optional[bool] = None,
+):
+    """Build a flash ``attention_fn`` pluggable into
+    ``BertEncoder(attention_fn=...)`` (same contract as ``dense_attention``)."""
+
+    def attention_fn(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
+                     deterministic=True):
+        if dropout_rate > 0.0 and not deterministic:
+            raise NotImplementedError(
+                "flash attention does not support attention-prob dropout; "
+                "set attention dropout to 0 (residual dropout is fine)"
+            )
+        mask = None
+        if bias is not None:
+            mask = (bias[:, 0, 0, :] > -1e8).astype(jnp.int32)
+        return flash_attention(
+            q, k, v, mask, causal=causal, block_q=block_q, block_k=block_k,
+            interpret=interpret,
+        )
+
+    return attention_fn
