@@ -22,6 +22,7 @@ from stoke_tpu.configs import (
     OffloadOptimizerConfig,
     OSSConfig,
     ParamNormalize,
+    PartitionRulesConfig,
     PrecisionConfig,
     PrecisionOptions,
     ProfilerConfig,
@@ -70,6 +71,7 @@ __all__ = [
     "SDDPConfig",
     "FSDPConfig",
     "OffloadOptimizerConfig",
+    "PartitionRulesConfig",
     "ActivationCheckpointingConfig",
     "CheckpointConfig",
     "ProfilerConfig",

@@ -334,6 +334,32 @@ class FSDPConfig:
 
 
 @dataclass
+class PartitionRulesConfig:
+    """User-supplied parameter partition rules — the tensor-parallelism hook.
+
+    No reference equivalent (SURVEY.md §2.8: the reference has no model
+    parallelism of any kind); this is TPU-native upside.  Each rule is
+    ``(path_regex, spec)`` where ``path_regex`` is matched (``re.search``)
+    against the '/'-joined parameter path and ``spec`` is a tuple of mesh
+    axis names / None per dimension (a PartitionSpec).  First matching rule
+    wins; non-matching parameters fall back to the active tier's placement
+    (so TP composes with dp/oss/sddp/fsdp).  Gradients and optimizer-state
+    leaves inherit the same matching (optax state paths contain the
+    parameter path).
+
+    Example (Megatron-style 2-way TP on a ("data","model") mesh):
+
+        PartitionRulesConfig(rules=(
+            (r"qkv/kernel",    (None, None, "model", None)),
+            (r"ff_in/kernel",  (None, "model")),
+            (r"ff_out/kernel", ("model", None)),
+        ))
+    """
+
+    rules: Tuple[Tuple[str, Tuple], ...] = ()
+
+
+@dataclass
 class OffloadOptimizerConfig:
     """Optimizer-state offload to host memory (ZeRO-offload equivalent).
 
@@ -391,11 +417,20 @@ class CheckpointConfig:
     torch.save, io_ops.py:551-623), ``sharded`` writes per-host shards with a
     metadata blob via orbax/tensorstore (reference DeepSpeed engine sharded
     save, io_ops.py:389-483).
+
+    ``save_every_n_steps`` + ``auto_path`` enable periodic auto-saving from
+    ``step()``/``train_step()``; with ``Stoke.maybe_resume()`` this is the
+    failure-recovery story (checkpoint-restart) — the reference has no
+    failure handling at all (SURVEY.md §5: "static world; crash = job
+    death").
     """
 
     format: CheckpointFormat = CheckpointFormat.consolidated
     max_to_keep: Optional[int] = None
     async_save: bool = False
+    save_every_n_steps: Optional[int] = None
+    auto_path: Optional[str] = None
+    auto_name: str = "auto"
 
 
 # --------------------------------------------------------------------------- #
@@ -453,6 +488,7 @@ ALL_CONFIG_CLASSES: Tuple[type, ...] = (
     SDDPConfig,
     FSDPConfig,
     OffloadOptimizerConfig,
+    PartitionRulesConfig,
     ActivationCheckpointingConfig,
     CheckpointConfig,
     ProfilerConfig,

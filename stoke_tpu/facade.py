@@ -157,6 +157,11 @@ class Stoke:
             st.oss_config,
             st.sddp_config,
             st.fsdp_config,
+            partition_rules=(
+                st.partition_rules_config.rules
+                if st.partition_rules_config is not None
+                else None
+            ),
         )
         if self._mesh is None:
             backend = "cpu" if st.device is DeviceOptions.cpu else None
@@ -462,6 +467,7 @@ class Stoke:
         self._optimizer_steps += 1
         self._grad_accum_counter = 0
         self._reset_tracking_window()
+        self._maybe_auto_save()
 
     @_timed("train_step")
     def train_step(
@@ -540,9 +546,42 @@ class Stoke:
             self._optimizer_steps += 1
             self._grad_accum_counter = 0
             self._reset_tracking_window()
+            self._maybe_auto_save()
         else:
             self._grad_accum_counter += 1
         return report
+
+    def _maybe_auto_save(self) -> None:
+        """Periodic checkpoint from the step path when
+        ``CheckpointConfig.save_every_n_steps`` is set — the crash-recovery
+        half of checkpoint-restart (SURVEY.md §5: the reference has none)."""
+        cfg = self._status_obj.checkpoint_config
+        if (
+            cfg.save_every_n_steps
+            and cfg.auto_path
+            and self._optimizer_steps > 0
+            and self._optimizer_steps % cfg.save_every_n_steps == 0
+        ):
+            self.save(cfg.auto_path, name=cfg.auto_name)
+
+    def maybe_resume(self, path: Optional[str] = None) -> bool:
+        """Resume from the newest auto-checkpoint if one exists; otherwise
+        start fresh.  Returns True when a checkpoint was loaded.  Combined
+        with ``CheckpointConfig(save_every_n_steps=..., auto_path=...)`` this
+        makes training loops restart-safe:
+
+            stoke.maybe_resume()
+            for batch in loader: stoke.train_step(*batch)
+        """
+        cfg = self._status_obj.checkpoint_config
+        target = path or cfg.auto_path
+        if not target:
+            return False
+        try:
+            self.load(target, name=cfg.auto_name)
+            return True
+        except FileNotFoundError:
+            return False
 
     def reset(self) -> None:
         """Zero the accumulation buffer and counters without stepping

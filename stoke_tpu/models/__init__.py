@@ -12,6 +12,7 @@ from stoke_tpu.models.bert import (
     BertEncoder,
     BertForSequenceClassification,
     BertTiny,
+    bert_tensor_parallel_rules,
     dense_attention,
 )
 from stoke_tpu.models.resnet import (
@@ -30,6 +31,7 @@ __all__ = [
     "BertEncoder",
     "BertForSequenceClassification",
     "BertTiny",
+    "bert_tensor_parallel_rules",
     "dense_attention",
     "ResNet",
     "ResNet18",

@@ -193,3 +193,23 @@ class BertForSequenceClassification(nn.Module):
 
 BertBase = partial(BertForSequenceClassification, size_name="base")
 BertTiny = partial(BertForSequenceClassification, size_name="tiny")
+
+
+def bert_tensor_parallel_rules(model_axis: str = "model"):
+    """Megatron-style tensor-parallel partition rules for the BERT family
+    (for ``PartitionRulesConfig``; requires a mesh with ``model_axis`` and
+    heads/ff divisible by its size).
+
+    Column-parallel: qkv projection (split over heads) and ff_in (split over
+    the ff dim); row-parallel: attention output and ff_out (split over the
+    input dim).  GSPMD derives the all-reduces after the row-parallel
+    matmuls from these placements.
+    """
+    return (
+        (r"attention/qkv/kernel", (None, None, model_axis, None)),
+        (r"attention/qkv/bias", (None, model_axis, None)),
+        (r"attention/out/kernel", (model_axis, None)),
+        (r"ff_in/kernel", (None, model_axis)),
+        (r"ff_in/bias", (model_axis,)),
+        (r"ff_out/kernel", (model_axis, None)),
+    )

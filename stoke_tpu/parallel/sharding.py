@@ -69,18 +69,48 @@ def leaf_partition_spec(
     return P(*spec)
 
 
+def _path_str(path) -> str:
+    return "/".join(
+        str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", p))))
+        for p in path
+    )
+
+
 def sharding_tree(
     tree_shapes: Any,
     mesh: Mesh,
     spec_fn: Callable[[tuple], P],
+    overrides: Optional[Any] = None,
+    strict_overrides: bool = True,
 ) -> Any:
-    """Map a pytree of arrays/ShapeDtypeStructs to a pytree of NamedShardings."""
+    """Map a pytree of arrays/ShapeDtypeStructs to a pytree of NamedShardings.
 
-    def _one(leaf):
+    ``overrides`` is a sequence of compiled ``(regex, P)`` pairs matched
+    against the '/'-joined leaf path; first match wins over ``spec_fn``
+    (the tensor-parallelism hook, see PartitionRulesConfig).  With
+    ``strict_overrides=False`` a rank mismatch falls back to ``spec_fn``
+    instead of raising (used for optimizer-state trees, where e.g.
+    factored-statistics leaves share the parameter's path but not its rank).
+    """
+
+    def _spec_for(path, leaf):
         shape = tuple(getattr(leaf, "shape", ()) or ())
+        if overrides:
+            p = _path_str(path)
+            for rx, spec in overrides:
+                if rx.search(p):
+                    if len(spec) != len(shape):
+                        if strict_overrides:
+                            raise ValueError(
+                                f"Stoke -- partition rule {rx.pattern!r} has "
+                                f"{len(spec)} entries but parameter {p} has "
+                                f"shape {shape}"
+                            )
+                        break
+                    return NamedSharding(mesh, spec)
         return NamedSharding(mesh, spec_fn(shape))
 
-    return jax.tree_util.tree_map(_one, tree_shapes)
+    return jax.tree_util.tree_map_with_path(_spec_for, tree_shapes)
 
 
 def batch_sharding(mesh: Optional[Mesh], axis_name: str = "data"):
@@ -111,7 +141,9 @@ class ShardingRules:
     ``None`` spec-fn means "replicated everywhere".  Built once by
     :func:`make_sharding_rules` from the validated status flags and consumed
     by the engine when it pins ``in_shardings``/``out_shardings`` on the
-    compiled steps.
+    compiled steps.  ``overrides`` are compiled path-regex partition rules
+    (tensor parallelism) that take precedence over the tier placement for
+    params, grads, AND matching optimizer-state leaves.
     """
 
     mesh: Optional[Mesh]
@@ -119,18 +151,31 @@ class ShardingRules:
     param_spec: Callable[[tuple], P]
     grad_spec: Callable[[tuple], P]
     opt_spec: Callable[[tuple], P]
+    overrides: Optional[Any] = None
 
     def param_shardings(self, tree_shapes):
-        return sharding_tree(tree_shapes, self.mesh, self.param_spec)
+        return sharding_tree(tree_shapes, self.mesh, self.param_spec, self.overrides)
 
     def grad_shardings(self, tree_shapes):
-        return sharding_tree(tree_shapes, self.mesh, self.grad_spec)
+        return sharding_tree(tree_shapes, self.mesh, self.grad_spec, self.overrides)
 
     def opt_shardings(self, tree_shapes):
-        return sharding_tree(tree_shapes, self.mesh, self.opt_spec)
+        return sharding_tree(
+            tree_shapes, self.mesh, self.opt_spec, self.overrides,
+            strict_overrides=False,
+        )
 
     def replicated(self):
         return NamedSharding(self.mesh, P())
+
+
+def compile_partition_rules(rules) -> Optional[list]:
+    """Compile (regex, spec-tuple) pairs into (pattern, PartitionSpec)."""
+    import re
+
+    if not rules:
+        return None
+    return [(re.compile(rx), P(*spec)) for rx, spec in rules]
 
 
 def make_sharding_rules(
@@ -140,11 +185,15 @@ def make_sharding_rules(
     oss_config: OSSConfig,
     sddp_config: SDDPConfig,
     fsdp_config: FSDPConfig,
+    partition_rules=None,
 ) -> Optional[ShardingRules]:
     """Build the tier's placement rules (the ladder table in the module
-    docstring).  Returns None when there is no mesh (single-device)."""
+    docstring).  Returns None when there is no mesh (single-device).
+    ``partition_rules`` are user (path-regex → spec) overrides — the tensor
+    parallelism hook (PartitionRulesConfig)."""
     if mesh is None:
         return None
+    overrides = compile_partition_rules(partition_rules)
     size = mesh.shape[axis_name]
     repl: Callable[[tuple], P] = lambda shape: P()
     shard_opt = lambda shape: leaf_partition_spec(
@@ -161,14 +210,14 @@ def make_sharding_rules(
         fsdp_config.shard_axis_preference,
     )
     if tier is ShardingOptions.none:
-        return ShardingRules(mesh, axis_name, repl, repl, repl)
+        return ShardingRules(mesh, axis_name, repl, repl, repl, overrides)
     if tier is ShardingOptions.oss:
-        return ShardingRules(mesh, axis_name, repl, repl, shard_opt)
+        return ShardingRules(mesh, axis_name, repl, repl, shard_opt, overrides)
     if tier is ShardingOptions.sddp:
-        return ShardingRules(mesh, axis_name, repl, shard_grad, shard_opt)
+        return ShardingRules(mesh, axis_name, repl, shard_grad, shard_opt, overrides)
     if tier is ShardingOptions.fsdp:
         # FSDP: params/grads/opt all follow the *param* placement so the
         # update is fully local (reference FSDP shards the flat param and
         # derives grad/opt shards from it, extensions.py:289-376).
-        return ShardingRules(mesh, axis_name, shard_param, shard_param, shard_param)
+        return ShardingRules(mesh, axis_name, shard_param, shard_param, shard_param, overrides)
     raise ValueError(f"unknown sharding tier {tier}")

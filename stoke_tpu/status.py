@@ -36,6 +36,7 @@ from stoke_tpu.configs import (
     MeshConfig,
     OffloadOptimizerConfig,
     OSSConfig,
+    PartitionRulesConfig,
     PrecisionConfig,
     PrecisionOptions,
     ProfilerConfig,
@@ -362,6 +363,11 @@ class StokeStatus:
     @property
     def fsdp_config(self) -> FSDPConfig:
         return self._get_or_default(FSDPConfig)
+
+    @property
+    def partition_rules_config(self):
+        """None unless explicitly supplied (tensor parallelism is opt-in)."""
+        return self._configs.get("PartitionRulesConfig")
 
     @property
     def offload_optimizer_config(self):

@@ -32,7 +32,8 @@ def make(distributed=None, fmt=CheckpointFormat.consolidated, **kw):
         "w2": jnp.asarray(r.normal(size=(32, 4)).astype(np.float32) * 0.1),
     }
     cfgs = list(kw.pop("configs", []))
-    cfgs.append(CheckpointConfig(format=fmt, max_to_keep=kw.pop("max_keep", None)))
+    if not any(isinstance(c, CheckpointConfig) for c in cfgs):
+        cfgs.append(CheckpointConfig(format=fmt, max_to_keep=kw.pop("max_keep", None)))
     if distributed:
         cfgs.append(FSDPConfig(min_weight_size=1))
     return Stoke(
@@ -188,6 +189,29 @@ def test_max_to_keep(tmp_path):
         s.save(path)
     tags = [d for d in os.listdir(path) if d.startswith("stoke-")]
     assert len(tags) == 2
+
+
+def test_auto_save_and_maybe_resume(tmp_path):
+    """Checkpoint-restart: periodic auto-save from the step path + resume
+    into a fresh instance (SURVEY.md §5 — the reference has no failure
+    recovery)."""
+    from stoke_tpu import CheckpointConfig
+
+    path = str(tmp_path / "auto")
+    mk = lambda: make(
+        configs=[CheckpointConfig(save_every_n_steps=2, auto_path=path, max_to_keep=1)]
+    )
+    s = mk()
+    assert s.maybe_resume() is False  # nothing to resume yet
+    train_a_bit(s, steps=5)  # auto-saves at steps 2 and 4
+    s2 = mk()
+    assert s2.maybe_resume() is True
+    assert s2.optimizer_steps == 4
+    np.testing.assert_allclose(
+        np.asarray(s2.params["w1"]),
+        np.asarray(train_a_bit(make(), steps=4).params["w1"]),
+        rtol=1e-5,
+    )
 
 
 def test_structure_mismatch_rejected(tmp_path):
