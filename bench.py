@@ -19,11 +19,50 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
-
 A100_BASELINE_IMGS_PER_SEC = 20000.0
+WATCHDOG_SECONDS = 1500
+
+
+def _supervise(argv) -> int:
+    """Run the real bench in a subprocess with a watchdog.
+
+    The TPU in this environment is reached through a remote tunnel that can
+    wedge; a wedged tunnel hangs *any* process at jax import.  This wrapper
+    (which never imports jax) guarantees the driver always gets its one JSON
+    line, even if the measurement process hangs or dies.
+    """
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--_worker", *argv],
+            capture_output=True,
+            text=True,
+            timeout=WATCHDOG_SECONDS,
+        )
+        for line in reversed(out.stdout.strip().splitlines()):
+            if line.startswith("{"):
+                print(line)
+                return 0
+        err = (out.stderr or "no JSON output").strip().splitlines()
+        detail = err[-1][:200] if err else "unknown"
+    except subprocess.TimeoutExpired:
+        detail = f"timeout after {WATCHDOG_SECONDS}s (TPU tunnel wedged?)"
+    print(
+        json.dumps(
+            {
+                "metric": "cifar10_resnet50_bf16_train_throughput",
+                "value": 0.0,
+                "unit": "imgs/sec/chip",
+                "vs_baseline": 0.0,
+                "error": detail,
+            }
+        )
+    )
+    return 1
 
 
 def main():
@@ -33,7 +72,12 @@ def main():
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--warmup", type=int, default=None)
+    ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
+    if not args._worker:
+        sys.exit(_supervise(sys.argv[1:]))
+
+    import numpy as np
 
     import jax
     import optax

@@ -64,20 +64,11 @@ class SyntheticSeqClsDataset:
         return [len(s) for s in self.seqs]
 
 
-def pad_collate(samples):
-    """Pad to the batch max length (bucketing keeps this close to the true
-    lengths) and emit input_ids / attention_mask / labels."""
-    seqs, labels = zip(*samples)
-    max_len = max(len(s) for s in seqs)
-    # round up to a multiple of 32: limits XLA recompilation across batches
-    # and satisfies the flash/ring block and shard divisibility constraints
-    max_len = ((max_len + 31) // 32) * 32
-    ids = np.zeros((len(seqs), max_len), np.int32)
-    mask = np.zeros((len(seqs), max_len), np.int32)
-    for i, s in enumerate(seqs):
-        ids[i, : len(s)] = s
-        mask[i, : len(s)] = 1
-    return {"input_ids": ids, "attention_mask": mask}, np.asarray(labels, np.int64)
+# batch assembly (gather + pad-to-batch-max + mask) runs natively: the
+# dataset is wrapped in RaggedSequenceDataset, whose loader path calls the
+# C++ NativeBatcher.gather_pad in one GIL-free call per batch; max length is
+# rounded to a multiple of 32 (bounds XLA recompilation, satisfies flash/
+# ring divisibility)
 
 
 def main():
@@ -164,19 +155,21 @@ def main():
         model_eval_kwargs={"train": False},
     )
 
+    from stoke_tpu import RaggedSequenceDataset
+
+    ragged = RaggedSequenceDataset(ds.seqs, ds.labels, pad_multiple=32)
     # sort by length → bucket → similar-length batches (reference README.md:43-45)
-    sorted_idx = list(np.argsort(ds.lengths()))
     world = stoke.world_size
     per_process = stoke.batch_size * (world // max(stoke.n_processes, 1))
     sampler = BucketedDistributedSampler(
-        ds,
+        ragged,
         buckets=args.buckets,
         batch_size=per_process,
-        sorted_idx=sorted_idx,
+        sorted_idx=ragged.sorted_idx(),
         num_replicas=stoke.n_processes,
         rank=stoke.rank,
     )
-    loader = stoke.DataLoader(ds, sampler=sampler, collate_fn=pad_collate)
+    loader = stoke.DataLoader(ragged, sampler=sampler)
 
     for epoch in range(args.epochs):
         loader.set_epoch(epoch)

@@ -30,7 +30,12 @@ from stoke_tpu.configs import (
     ShardingOptions,
     StokeOptimizer,
 )
-from stoke_tpu.data import ArrayDataset, BucketedDistributedSampler, StokeDataLoader
+from stoke_tpu.data import (
+    ArrayDataset,
+    BucketedDistributedSampler,
+    RaggedSequenceDataset,
+    StokeDataLoader,
+)
 from stoke_tpu.engine import (
     DeferredOutput,
     FlaxModelAdapter,
@@ -52,6 +57,7 @@ __all__ = [
     "StokeDataLoader",
     "BucketedDistributedSampler",
     "ArrayDataset",
+    "RaggedSequenceDataset",
     # enums
     "DeviceOptions",
     "DistributedOptions",

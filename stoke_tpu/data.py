@@ -103,6 +103,94 @@ class _NativeArrayLoader:
             yield batch if len(batch) > 1 else batch[0]
 
 
+class RaggedSequenceDataset:
+    """Variable-length token sequences in one contiguous ragged buffer, with
+    native batch assembly.
+
+    The BERT/bucketed-sampler pipeline's hot path is "gather sampled
+    sequences + pad to the batch max + build the attention mask"; with this
+    dataset a ``StokeDataLoader`` does all three in one GIL-free native call
+    (``NativeBatcher.gather_pad``).  Pairs naturally with
+    ``BucketedDistributedSampler`` (use :meth:`sorted_idx`).
+
+    Args:
+        sequences: list of 1-D int token arrays.
+        labels: optional per-sequence labels.
+        pad_multiple: pad batch max-length up to a multiple (bounds XLA
+            recompilation and satisfies flash/ring divisibility).
+    """
+
+    def __init__(self, sequences, labels=None, pad_multiple: int = 32):
+        self.lengths = np.asarray([len(s) for s in sequences], np.int32)
+        self.offsets = np.concatenate(
+            [[0], np.cumsum(self.lengths[:-1], dtype=np.int64)]
+        ).astype(np.int64)
+        self.ragged = (
+            np.concatenate([np.asarray(s, np.int32) for s in sequences])
+            if len(sequences)
+            else np.zeros((0,), np.int32)
+        )
+        self.labels = None if labels is None else np.asarray(labels)
+        self.pad_multiple = int(pad_multiple)
+
+    def __len__(self):
+        return len(self.lengths)
+
+    def __getitem__(self, i):
+        s = self.ragged[self.offsets[i] : self.offsets[i] + self.lengths[i]]
+        return (s, self.labels[i]) if self.labels is not None else s
+
+    def sorted_idx(self):
+        """Indices sorted by length — feed to BucketedDistributedSampler."""
+        return list(np.argsort(self.lengths, kind="stable"))
+
+
+class _NativeRaggedLoader:
+    """Sampler-driven loader over a RaggedSequenceDataset: one native
+    gather+pad per batch, yielding ({input_ids, attention_mask}, labels?)."""
+
+    def __init__(self, dataset: RaggedSequenceDataset, batch_size: int,
+                 shuffle: bool = False, sampler=None, drop_last: bool = False,
+                 seed: int = 0, **_unused):
+        from stoke_tpu.native import NativeBatcher
+
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.sampler = sampler
+        self.drop_last = drop_last
+        self._epoch_seed = seed
+        self._batcher = NativeBatcher()
+
+    def __len__(self):
+        n = len(self.sampler) if self.sampler is not None else len(self.dataset)
+        return n // self.batch_size if self.drop_last else math.ceil(n / self.batch_size)
+
+    def __iter__(self):
+        ds = self.dataset
+        if self.sampler is not None:
+            order = np.fromiter(iter(self.sampler), np.int64)
+        else:
+            order = np.arange(len(ds), dtype=np.int64)
+            if self.shuffle:
+                rng = np.random.default_rng(self._epoch_seed)
+                self._epoch_seed += 1
+                rng.shuffle(order)
+        for start in range(0, len(order), self.batch_size):
+            idx = order[start : start + self.batch_size]
+            if self.drop_last and len(idx) < self.batch_size:
+                break
+            ids, mask = self._batcher.gather_pad(
+                ds.ragged, ds.offsets, ds.lengths, idx,
+                pad_multiple=ds.pad_multiple,
+            )
+            batch = {"input_ids": ids, "attention_mask": mask}
+            if ds.labels is not None:
+                yield batch, ds.labels[idx]
+            else:
+                yield batch
+
+
 # --------------------------------------------------------------------------- #
 # Loader
 # --------------------------------------------------------------------------- #
@@ -196,6 +284,10 @@ class StokeDataLoader:
         if isinstance(dataset, ArrayDataset):
             # native fast path: one GIL-free row-gather per array per batch
             self._loader = _NativeArrayLoader(dataset, batch_size=batch_size, **kwargs)
+            return
+        if isinstance(dataset, RaggedSequenceDataset):
+            # native ragged fast path: gather + pad + mask in one call
+            self._loader = _NativeRaggedLoader(dataset, batch_size=batch_size, **kwargs)
             return
         try:
             from torch.utils import data as torch_data

@@ -15,6 +15,7 @@ from stoke_tpu.models.bert import (
     bert_tensor_parallel_rules,
     dense_attention,
 )
+from stoke_tpu.models.gpt import GPT, GPTBase, GPTTiny, causal_lm_loss
 from stoke_tpu.models.resnet import (
     ResNet,
     ResNet18,
@@ -33,6 +34,10 @@ __all__ = [
     "BertTiny",
     "bert_tensor_parallel_rules",
     "dense_attention",
+    "GPT",
+    "GPTBase",
+    "GPTTiny",
+    "causal_lm_loss",
     "ResNet",
     "ResNet18",
     "ResNet34",

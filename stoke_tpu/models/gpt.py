@@ -1,0 +1,96 @@
+"""GPT-style decoder-only causal language model.
+
+Rounds out the model library (the reference ships no models; SURVEY.md §2.7
+has only the CIFAR example).  Reuses the transformer blocks from
+``stoke_tpu.models.bert`` with causal attention; works with dense attention
+(causal bias built in-model), the pallas flash kernel
+(``make_flash_attention(causal=True)``), or sequence-parallel ring/Ulysses
+(``make_ring_attention(..., causal=True)``) — set ``attention_is_causal``
+when the attention_fn enforces causality itself.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from stoke_tpu.models.bert import (
+    BERT_SIZES,
+    BertSize,
+    TransformerBlock,
+    dense_attention,
+)
+
+
+class GPT(nn.Module):
+    """Decoder-only LM: learned token+position embeddings, pre-LN-free
+    (reuses the post-LN blocks), weight-tied LM head.
+
+    Args:
+        size_name: one of BERT_SIZES ("tiny"…"large") — decoder uses the
+            same width table.
+        attention_is_causal: True when ``attention_fn`` already applies the
+            causal mask (flash/ring/ulysses built with ``causal=True``);
+            False (default) builds an additive causal bias for dense
+            attention.
+        tie_embeddings: LM head = transpose of the token embedding.
+    """
+
+    vocab_size: int = 50257
+    size_name: str = "tiny"
+    max_len: int = 1024
+    dropout_rate: float = 0.1
+    attention_fn: Callable = dense_attention
+    attention_is_causal: bool = False
+    tie_embeddings: bool = True
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, input_ids, train: bool = True):
+        size: BertSize = BERT_SIZES[self.size_name]
+        B, L = input_ids.shape
+        tok_emb = nn.Embed(self.vocab_size, size.hidden, name="tok_emb")
+        h = tok_emb(input_ids)
+        pos = jnp.arange(L)[None, :]
+        h = h + nn.Embed(self.max_len, size.hidden, name="pos_emb")(pos)
+        h = nn.Dropout(self.dropout_rate)(h, deterministic=not train)
+        if self.attention_is_causal:
+            bias = None
+        else:
+            causal = jnp.tril(jnp.ones((L, L), bool))
+            bias = jnp.where(causal, 0.0, -1e9)[None, None, :, :].astype(h.dtype)
+        block = TransformerBlock
+        if self.remat:
+            block = nn.remat(TransformerBlock, static_argnums=(3,))
+        for i in range(size.num_layers):
+            h = block(
+                size.hidden, size.heads, size.ff, self.dropout_rate,
+                self.attention_fn, name=f"layer_{i}",
+            )(h, bias, not train)
+        h = nn.LayerNorm(epsilon=1e-5, name="ln_final")(h)
+        if self.tie_embeddings:
+            return tok_emb.attend(h)
+        return nn.Dense(self.vocab_size, name="lm_head")(h)
+
+
+GPTTiny = partial(GPT, size_name="tiny")
+GPTBase = partial(GPT, size_name="base")
+
+
+def causal_lm_loss(logits, input_ids, mask=None):
+    """Next-token cross entropy: predict token t+1 from positions ≤ t.
+    ``mask`` (optional [B, L] 0/1) excludes padding targets."""
+    import optax
+
+    targets = input_ids[:, 1:]
+    logits = logits[:, :-1]
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    if mask is not None:
+        w = mask[:, 1:].astype(losses.dtype)
+        return (losses * w).sum() / jnp.maximum(w.sum(), 1.0)
+    return losses.mean()
