@@ -64,12 +64,13 @@ class ArrayDataset:
         return row if len(row) > 1 else row[0]
 
 
-class _NativeArrayLoader:
-    """Sampler-driven loader over an ArrayDataset using the native batcher."""
+class _NativeLoaderBase:
+    """Sampler/shuffle/drop_last machinery shared by the native fast-path
+    loaders; subclasses implement ``_assemble(idx)``."""
 
-    def __init__(self, dataset: ArrayDataset, batch_size: int,
-                 shuffle: bool = False, sampler=None, drop_last: bool = False,
-                 seed: int = 0, **_unused):
+    def __init__(self, dataset, batch_size: int, shuffle: bool = False,
+                 sampler=None, drop_last: bool = False, seed: int = 0,
+                 **_unused):
         from stoke_tpu.native import NativeBatcher
 
         self.dataset = dataset
@@ -84,6 +85,9 @@ class _NativeArrayLoader:
         n = len(self.sampler) if self.sampler is not None else len(self.dataset)
         return n // self.batch_size if self.drop_last else math.ceil(n / self.batch_size)
 
+    def _assemble(self, idx: np.ndarray):
+        raise NotImplementedError
+
     def __iter__(self):
         if self.sampler is not None:
             order = np.fromiter(iter(self.sampler), np.int64)
@@ -97,10 +101,17 @@ class _NativeArrayLoader:
             idx = order[start : start + self.batch_size]
             if self.drop_last and len(idx) < self.batch_size:
                 break
-            batch = tuple(
-                self._batcher.gather_rows(a, idx) for a in self.dataset.arrays
-            )
-            yield batch if len(batch) > 1 else batch[0]
+            yield self._assemble(idx)
+
+
+class _NativeArrayLoader(_NativeLoaderBase):
+    """ArrayDataset fast path: one GIL-free row-gather per array."""
+
+    def _assemble(self, idx):
+        batch = tuple(
+            self._batcher.gather_rows(a, idx) for a in self.dataset.arrays
+        )
+        return batch if len(batch) > 1 else batch[0]
 
 
 class RaggedSequenceDataset:
@@ -145,50 +156,20 @@ class RaggedSequenceDataset:
         return list(np.argsort(self.lengths, kind="stable"))
 
 
-class _NativeRaggedLoader:
-    """Sampler-driven loader over a RaggedSequenceDataset: one native
-    gather+pad per batch, yielding ({input_ids, attention_mask}, labels?)."""
+class _NativeRaggedLoader(_NativeLoaderBase):
+    """RaggedSequenceDataset fast path: native gather+pad+mask in one call,
+    yielding ({input_ids, attention_mask}, labels?)."""
 
-    def __init__(self, dataset: RaggedSequenceDataset, batch_size: int,
-                 shuffle: bool = False, sampler=None, drop_last: bool = False,
-                 seed: int = 0, **_unused):
-        from stoke_tpu.native import NativeBatcher
-
-        self.dataset = dataset
-        self.batch_size = batch_size
-        self.shuffle = shuffle
-        self.sampler = sampler
-        self.drop_last = drop_last
-        self._epoch_seed = seed
-        self._batcher = NativeBatcher()
-
-    def __len__(self):
-        n = len(self.sampler) if self.sampler is not None else len(self.dataset)
-        return n // self.batch_size if self.drop_last else math.ceil(n / self.batch_size)
-
-    def __iter__(self):
+    def _assemble(self, idx):
         ds = self.dataset
-        if self.sampler is not None:
-            order = np.fromiter(iter(self.sampler), np.int64)
-        else:
-            order = np.arange(len(ds), dtype=np.int64)
-            if self.shuffle:
-                rng = np.random.default_rng(self._epoch_seed)
-                self._epoch_seed += 1
-                rng.shuffle(order)
-        for start in range(0, len(order), self.batch_size):
-            idx = order[start : start + self.batch_size]
-            if self.drop_last and len(idx) < self.batch_size:
-                break
-            ids, mask = self._batcher.gather_pad(
-                ds.ragged, ds.offsets, ds.lengths, idx,
-                pad_multiple=ds.pad_multiple,
-            )
-            batch = {"input_ids": ids, "attention_mask": mask}
-            if ds.labels is not None:
-                yield batch, ds.labels[idx]
-            else:
-                yield batch
+        ids, mask = self._batcher.gather_pad(
+            ds.ragged, ds.offsets, ds.lengths, idx,
+            pad_multiple=ds.pad_multiple,
+        )
+        batch = {"input_ids": ids, "attention_mask": mask}
+        if ds.labels is not None:
+            return batch, ds.labels[idx]
+        return batch
 
 
 # --------------------------------------------------------------------------- #

@@ -626,6 +626,86 @@ class StepEngine:
             return jax.jit(_step, out_shardings=out_sh)
         return jax.jit(_step)
 
+    # ----------------------- scan window step --------------------------- #
+
+    def window_step(
+        self,
+        variables,
+        opt_state,
+        grad_buf,
+        scaler_state,
+        rng,
+        margs_stacked: tuple,
+        mkwargs_stacked: dict,
+        loss_args_flat_stacked: list,
+        loss_treedef,
+        deferred_info: Tuple[Tuple[int, Tuple], ...],
+    ):
+        """A WHOLE accumulation window in one compiled dispatch:
+        ``lax.scan`` over the k stacked micro-batches (grad accumulation as
+        compiler-visible control flow — SURVEY.md §3.2 observation (b)),
+        then the fused optimizer apply.  Semantically identical to k
+        ``train_step`` calls; one dispatch instead of k.
+
+        Stacked args carry the micro dimension on axis 0 (leaf shape
+        [k, micro_batch, ...]).  Returns (reports_stacked, variables,
+        opt_state, grad_buf, scaler_state, rng, finite).
+        """
+        key = (
+            "window",
+            jax.tree_util.tree_structure((margs_stacked, mkwargs_stacked)),
+            loss_treedef,
+            deferred_info,
+        )
+        if key not in self._accum_cache:
+            self._accum_cache[key] = self._build_window(loss_treedef, deferred_info)
+        return self._accum_cache[key](
+            variables, opt_state, grad_buf, scaler_state, rng,
+            margs_stacked, mkwargs_stacked, loss_args_flat_stacked,
+        )
+
+    def _build_window(self, loss_treedef, deferred_info):
+        accum = self._accum_core(loss_treedef, deferred_info, training=True)
+        apply_core = self._apply_core()
+
+        def _window(variables, opt_state, grad_buf, scaler_state, rng,
+                    margs_s, mkwargs_s, larr_s):
+            params = variables["params"]
+            nonparam0 = {k: v for k, v in variables.items() if k != "params"}
+
+            def body(carry, xs):
+                nonparam, buf, rng = carry
+                margs, mkwargs, larr = xs
+                report, updated, buf, rng = accum(
+                    {"params": params, **nonparam}, buf, scaler_state, rng,
+                    margs, mkwargs, larr,
+                )
+                return ({**nonparam, **updated}, buf, rng), report
+
+            (nonparam_f, new_buf, new_rng), reports = jax.lax.scan(
+                body, (nonparam0, grad_buf, rng), (margs_s, mkwargs_s, larr_s)
+            )
+            merged = {"params": params, **nonparam_f}
+            new_vars, new_opt, zero_buf, new_scaler, finite = apply_core(
+                merged, opt_state, new_buf, scaler_state
+            )
+            return (reports, new_vars, new_opt, zero_buf, new_scaler,
+                    new_rng, finite)
+
+        if self.rules is not None:
+            repl = self._repl
+            out_sh = (
+                None,
+                self._var_shardings,
+                self._opt_shardings,
+                self._grad_shardings,
+                {"scale": repl, "growth_count": repl},
+                repl,
+                repl,
+            )
+            return jax.jit(_window, out_shardings=out_sh, donate_argnums=(0, 1, 2))
+        return jax.jit(_window, donate_argnums=(0, 1, 2))
+
     # ---------------------------- apply step --------------------------- #
 
     def apply_step(self, variables, opt_state, grad_buf, scaler_state):

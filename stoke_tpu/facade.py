@@ -287,19 +287,22 @@ class Stoke:
             return jax.tree_util.tree_map(lambda x: jax.device_put(x, repl), tree)
         return jax.device_put(tree, self._device)
 
-    def _batch_sharding_for(self, shape):
+    def _batch_sharding_for(self, shape, batch_dim: int = 0):
         if self._mesh is None:
             return self._device
         axis = self._rules.axis_name
-        if shape and shape[0] % self._mesh.shape[axis] == 0:
-            return NamedSharding(self._mesh, P(axis))
+        if len(shape) > batch_dim and shape[batch_dim] % self._mesh.shape[axis] == 0:
+            spec = [None] * (batch_dim + 1)
+            spec[batch_dim] = axis
+            return NamedSharding(self._mesh, P(*spec))
         return NamedSharding(self._mesh, P())
 
-    def _place_batch(self, tree):
+    def _place_batch(self, tree, batch_dim: int = 0):
         """Host batch → device, sharded over the data axis (the TPU
         equivalent of ``place_data_on_gpu``, reference utils.py:39-80; for
         multi-host, each process contributes its local slice of the
-        logically-global batch)."""
+        logically-global batch).  ``batch_dim=1`` serves stacked
+        [grad_accum, micro_batch, ...] windows."""
 
         def _leaf(x):
             if isinstance(x, jax.Array):
@@ -307,7 +310,7 @@ class Stoke:
             if hasattr(x, "detach"):  # torch tensor
                 x = x.detach().cpu().numpy()
             x = np.asarray(x)
-            sh = self._batch_sharding_for(x.shape)
+            sh = self._batch_sharding_for(x.shape, batch_dim)
             if self._mesh is not None and jax.process_count() > 1:
                 return jax.make_array_from_process_local_data(sh, x)
             return jax.device_put(x, sh)
@@ -582,6 +585,89 @@ class Stoke:
             return True
         except FileNotFoundError:
             return False
+
+    @_timed("train_step_window")
+    def train_step_window(
+        self,
+        model_args: Any,
+        loss_args: Any = (),
+        model_kwargs: Optional[dict] = None,
+    ):
+        """A whole accumulation window (``grad_accum`` micro-batches) in ONE
+        compiled dispatch via ``lax.scan``, apply included.
+
+        Args are stacked micro-batches: each array leaf has shape
+        ``[grad_accum, micro_batch, ...]``.  Must be called at a window
+        boundary (``grad_accum_counter == 0``).  Returns the per-micro loss
+        reports stacked on axis 0.
+        """
+        if not self._training:
+            raise RuntimeError("Stoke -- train_step_window() called in eval mode")
+        if self._grad_accum_counter != 0:
+            raise RuntimeError(
+                "Stoke -- train_step_window() must start at an accumulation "
+                f"boundary (counter={self._grad_accum_counter}); finish the "
+                "window with backward()/step() or reset() first"
+            )
+        k = self._status_obj.grad_accum
+        if not isinstance(model_args, tuple):
+            model_args = (model_args,)
+        if not isinstance(loss_args, tuple):
+            loss_args = (loss_args,)
+        for leaf in jax.tree_util.tree_leaves(
+            (model_args, loss_args, model_kwargs or {})
+        ):
+            if hasattr(leaf, "shape") and (not leaf.shape or leaf.shape[0] != k):
+                raise ValueError(
+                    f"Stoke -- train_step_window() expects leaves stacked to "
+                    f"[grad_accum={k}, ...]; got shape {getattr(leaf, 'shape', ())}"
+                )
+        margs = self._place_batch(model_args, batch_dim=1)
+        mkwargs = self._place_batch(model_kwargs or {}, batch_dim=1)
+        sentinel = DeferredOutput(None, -1)
+        flat, treedef = jax.tree_util.tree_flatten(
+            ((sentinel, *loss_args), {}), is_leaf=is_deferred
+        )
+        arrays = self._place_batch(
+            [l for l in flat if not is_deferred(l)], batch_dim=1
+        )
+        deferred_info = tuple(
+            (i, l._path) for i, l in enumerate(flat) if is_deferred(l)
+        )
+        (
+            reports,
+            self._variables,
+            self._opt_state,
+            self._grad_buf,
+            self._scaler_state,
+            self._rng,
+            finite,
+        ) = self._engine.window_step(
+            self._variables,
+            self._opt_state,
+            self._grad_buf,
+            self._scaler_state,
+            self._rng,
+            margs,
+            mkwargs,
+            arrays,
+            treedef,
+            deferred_info,
+        )
+        self._pending = None
+        self._backward_steps += k
+        # track the window-mean micro loss once (per-micro EMA would need k
+        # host round trips; the stacked reports carry the detail)
+        mean_report = jax.tree_util.tree_map(lambda r: r.mean(axis=0), reports)
+        self._update_loss_tracking(mean_report)
+        if self._precision.scaled:
+            self._skipped_steps = self._skipped_steps + (
+                1.0 - finite.astype(jnp.float32)
+            )
+        self._optimizer_steps += 1
+        self._reset_tracking_window()
+        self._maybe_auto_save()
+        return reports
 
     def reset(self) -> None:
         """Zero the accumulation buffer and counters without stepping

@@ -54,6 +54,12 @@ class GPT(nn.Module):
     def __call__(self, input_ids, train: bool = True):
         size: BertSize = BERT_SIZES[self.size_name]
         B, L = input_ids.shape
+        if L > self.max_len:
+            # XLA would silently clamp out-of-range position indices,
+            # collapsing every position past max_len onto one embedding
+            raise ValueError(
+                f"GPT: sequence length {L} exceeds max_len={self.max_len}"
+            )
         tok_emb = nn.Embed(self.vocab_size, size.hidden, name="tok_emb")
         h = tok_emb(input_ids)
         pos = jnp.arange(L)[None, :]

@@ -138,6 +138,31 @@ def test_grad_accum_distributed(devices):
     assert s.optimizer_steps == 2
 
 
+def test_window_step_distributed_matches(devices):
+    """Scanned window step on the sharded mesh == per-micro 4-call steps."""
+    r = np.random.default_rng(3)
+    W = r.normal(size=(IN, OUT)).astype(np.float32)
+    micro = []
+    for _ in range(2):
+        x = r.normal(size=(32, IN)).astype(np.float32)
+        micro.append((x, (x @ W).astype(np.float32)))
+
+    s1 = make(distributed="dp", oss=True, sddp=True, grad_accum=2)
+    for x, y in micro:
+        s1.backward(s1.loss(s1.model(x), y))
+        s1.step()
+
+    s2 = make(distributed="dp", oss=True, sddp=True, grad_accum=2)
+    s2.train_step_window(
+        np.stack([x for x, _ in micro]), np.stack([y for _, y in micro])
+    )
+    assert s2.optimizer_steps == 1
+    np.testing.assert_allclose(
+        np.asarray(s1.params["w1"]), np.asarray(s2.params["w1"]),
+        rtol=1e-4, atol=1e-6,
+    )
+
+
 def test_fsdp_apply_keeps_param_placement(devices):
     """After an optimizer step the params must still be sharded (no drift to
     replicated — the out_shardings pin, engine.py)."""

@@ -360,6 +360,42 @@ def test_train_step_fp16_skips_on_overflow(rng):
     assert s.skipped_optimizer_steps == 1.0
 
 
+def test_train_step_window_matches_four_call(rng):
+    """One scanned dispatch for the whole window == k 4-call micro-steps."""
+    k = 3
+    micro = [batch(rng) for _ in range(k)]
+    s1 = make_stoke(grad_accum=k)
+    for x, y in micro:
+        s1.backward(s1.loss(s1.model(x), y))
+        s1.step()
+    s2 = make_stoke(grad_accum=k)
+    xs = np.stack([x for x, _ in micro])
+    ys = np.stack([y for _, y in micro])
+    reports = s2.train_step_window(xs, ys)
+    assert np.asarray(reports).shape == (k,)
+    np.testing.assert_allclose(
+        np.asarray(s1.params["w"]), np.asarray(s2.params["w"]), rtol=1e-6
+    )
+    assert s2.optimizer_steps == 1 and s2.backward_steps == k
+    # per-micro reports match the 4-call losses
+    s3 = make_stoke(grad_accum=k)
+    for i, (x, y) in enumerate(micro):
+        l = s3.loss(s3.model(x), y)
+        s3.backward(l)
+        s3.step()
+        assert float(np.asarray(reports)[i]) == pytest.approx(float(l), rel=1e-5)
+
+
+def test_train_step_window_validations(rng):
+    s = make_stoke(grad_accum=2)
+    x, y = batch(rng)
+    with pytest.raises(ValueError):  # not stacked to k
+        s.train_step_window(x, y)
+    s.backward(s.loss(s.model(x), y))
+    with pytest.raises(RuntimeError):  # mid-window
+        s.train_step_window(np.stack([x, x]), np.stack([y, y]))
+
+
 # ------------------------- profiling -------------------------------------- #
 
 
