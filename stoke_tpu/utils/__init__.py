@@ -1,6 +1,7 @@
 """Utility helpers (reference: stoke/utils.py:1-151, TPU-native re-design)."""
 
 from stoke_tpu.utils.init import init_module
+from stoke_tpu.utils.yaml_config import stoke_from_config, stoke_kwargs_from_config
 from stoke_tpu.utils.printing import unrolled_print, make_folder
 from stoke_tpu.utils.trees import (
     tree_count_params,
@@ -15,6 +16,8 @@ from stoke_tpu.utils.trees import (
 
 __all__ = [
     "init_module",
+    "stoke_from_config",
+    "stoke_kwargs_from_config",
     "unrolled_print",
     "make_folder",
     "tree_count_params",

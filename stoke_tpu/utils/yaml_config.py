@@ -1,0 +1,148 @@
+"""Declarative YAML/dict → Stoke construction.
+
+The reference's example layer drives stoke with the ``spock`` YAML config
+library (examples/cifar10/train.py:60-62, configs.py:15-85); here the
+equivalent is a framework utility: one document describes every flag and
+config object, so experiments switch context by pointing at a different
+file (the reference demo story, README.md:13-20).
+
+Schema (all keys optional except batch_size_per_device):
+
+    batch_size_per_device: 64
+    grad_accum: 2
+    device: tpu
+    distributed: dp
+    precision: bf16
+    oss: false
+    sddp: false
+    fsdp: true
+    grad_clip: {type: norm, max_norm: 1.0}        # or {type: value, clip_value: 0.5}
+    optimizer: {name: adamw, learning_rate: 3.0e-4}
+    seed: 0
+    ema_weight: 0.1
+    configs:                                       # config objects by class name
+      FSDPConfig: {min_weight_size: 4096}
+      MeshConfig: {axes: [data, model], shape: [-1, 2]}
+      CheckpointConfig: {format: sharded, save_every_n_steps: 500,
+                         auto_path: ckpts/auto}
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Union
+
+from stoke_tpu.configs import (
+    ALL_CONFIG_CLASSES,
+    CheckpointFormat,
+    ClipGradConfig,
+    ClipGradNormConfig,
+    LossReduction,
+)
+
+_CONFIG_BY_NAME = {cls.__name__: cls for cls in ALL_CONFIG_CLASSES}
+# enum-valued fields that arrive as strings from YAML
+_ENUM_FIELDS = {"format": CheckpointFormat, "loss_reduction": LossReduction}
+
+_STOKE_FLAG_KEYS = (
+    "batch_size_per_device", "grad_accum", "device", "distributed",
+    "precision", "oss", "sddp", "fsdp", "seed", "ema_weight", "verbose",
+    "model_train_kwargs", "model_eval_kwargs", "model_rng_keys",
+)
+
+
+def _build_grad_clip(spec: Optional[Dict[str, Any]]):
+    if spec is None:
+        return None
+    spec = dict(spec)
+    kind = spec.pop("type", "norm")
+    if kind in ("norm", "clip_norm"):
+        return ClipGradNormConfig(**spec)
+    if kind in ("value", "clip_value"):
+        return ClipGradConfig(**spec)
+    raise ValueError(f"Stoke -- unknown grad_clip type {kind!r}")
+
+
+def _build_optimizer(spec: Optional[Dict[str, Any]]):
+    if spec is None:
+        return None
+    import optax
+
+    spec = dict(spec)
+    name = spec.pop("name")
+    ctor = getattr(optax, name, None)
+    if ctor is None:
+        raise ValueError(f"Stoke -- optax has no optimizer named {name!r}")
+    return {"optimizer": ctor, "optimizer_kwargs": spec}
+
+
+def _build_config_object(name: str, fields: Dict[str, Any]):
+    cls = _CONFIG_BY_NAME.get(name)
+    if cls is None:
+        raise ValueError(
+            f"Stoke -- unknown config class {name!r}; valid: "
+            f"{sorted(_CONFIG_BY_NAME)}"
+        )
+    fields = dict(fields or {})
+    for key, enum_cls in _ENUM_FIELDS.items():
+        if key in fields and isinstance(fields[key], str):
+            fields[key] = enum_cls(fields[key])
+    # YAML lists → tuples for tuple-typed fields (axes, shape, rules, ...)
+    for k, v in fields.items():
+        if isinstance(v, list):
+            fields[k] = tuple(tuple(i) if isinstance(i, list) else i for i in v)
+    return cls(**fields)
+
+
+def stoke_kwargs_from_config(cfg: Union[str, Dict[str, Any]]) -> Dict[str, Any]:
+    """Translate a YAML path / dict into ``Stoke(**kwargs)`` keyword args
+    (everything except model/loss/params).  Unknown top-level keys raise —
+    typos should not silently train a different run."""
+    if isinstance(cfg, str):
+        import yaml
+
+        with open(cfg) as f:
+            cfg = yaml.safe_load(f)
+    cfg = dict(cfg or {})
+    out: Dict[str, Any] = {}
+    for key in _STOKE_FLAG_KEYS:
+        if key in cfg:
+            out[key] = cfg.pop(key)
+    if "grad_clip" in cfg:
+        out["grad_clip"] = _build_grad_clip(cfg.pop("grad_clip"))
+    if "optimizer" in cfg:
+        out["optimizer"] = _build_optimizer(cfg.pop("optimizer"))
+    if "configs" in cfg:
+        out["configs"] = [
+            _build_config_object(name, fields)
+            for name, fields in (cfg.pop("configs") or {}).items()
+        ]
+    if cfg:
+        raise ValueError(f"Stoke -- unknown config keys: {sorted(cfg)}")
+    return out
+
+
+def stoke_from_config(
+    model: Any,
+    loss: Any,
+    params: Any,
+    cfg: Union[str, Dict[str, Any]],
+    optimizer: Any = None,
+    **overrides,
+):
+    """Build a :class:`~stoke_tpu.Stoke` from a YAML file / dict.
+
+    ``optimizer`` may come from the document (``optimizer: {name: ...}``) or
+    be passed explicitly (explicit wins).  ``overrides`` are applied last.
+    """
+    from stoke_tpu import Stoke
+
+    kwargs = stoke_kwargs_from_config(cfg)
+    if optimizer is not None:
+        kwargs["optimizer"] = optimizer
+    if "optimizer" not in kwargs:
+        raise ValueError(
+            "Stoke -- no optimizer: add an `optimizer:` section to the config "
+            "or pass one explicitly"
+        )
+    kwargs.update(overrides)
+    return Stoke(model=model, loss=loss, params=params, **kwargs)
