@@ -4,9 +4,11 @@ sharding-tier rules that replace the reference's per-backend process wrappers
 (SURVEY.md §2.9, §7)."""
 
 from stoke_tpu.parallel.mesh import build_mesh, initialize_distributed, local_device_count
+from stoke_tpu.parallel.pipeline import pipeline, stack_stage_params
 from stoke_tpu.parallel.sharding import (
     ShardingRules,
     batch_sharding,
+    compile_partition_rules,
     leaf_partition_spec,
     make_sharding_rules,
     sharding_tree,
@@ -18,7 +20,10 @@ __all__ = [
     "local_device_count",
     "ShardingRules",
     "batch_sharding",
+    "compile_partition_rules",
     "leaf_partition_spec",
     "make_sharding_rules",
     "sharding_tree",
+    "pipeline",
+    "stack_stage_params",
 ]

@@ -1,0 +1,106 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh axis.
+
+Capability upside beyond the reference (SURVEY.md §2.8: "no pipeline
+parallelism").  The pattern: identical stages live on consecutive devices of
+a ``stage`` mesh axis (stage s holds slice s of the stacked stage
+parameters); microbatches stream through — each tick every stage processes
+the activation it holds and ``ppermute``s the result to its neighbor (ICI
+link), so after a fill phase of S-1 ticks all stages compute concurrently.
+
+Differentiation is automatic: the transpose of ``ppermute`` is the reverse
+rotation, so ``jax.grad`` of the pipelined function IS backward pipelining
+(outputs of fill/drain garbage ticks are masked out, so their gradient
+contribution is exactly zero).
+
+This is the composable building block (function-level, mesh in hand); full
+facade integration (stage-stacked optimizers etc.) composes via
+``PartitionRulesConfig`` placing the stacked stage dimension.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from stoke_tpu.ops.attention import shard_map
+
+
+def pipeline(
+    stage_fn: Callable,
+    mesh: Mesh,
+    axis_name: str = "stage",
+) -> Callable:
+    """Build a pipelined apply from a single-stage function.
+
+    Args:
+        stage_fn: ``stage_fn(stage_params, x) -> y`` with ``y`` shaped like
+            ``x`` (stages must be shape-preserving, e.g. transformer blocks).
+        mesh: mesh containing ``axis_name`` (size S = number of stages).
+        axis_name: the pipeline axis.
+
+    Returns ``pipelined(stacked_params, xs)`` where ``stacked_params`` leaves
+    carry a leading stage dimension [S, ...] and ``xs`` is the microbatch
+    stream [M, micro_batch, ...]; result is [M, micro_batch, ...] equal to
+    running all S stages sequentially over each microbatch.
+    """
+    S = mesh.shape[axis_name]
+
+    def per_shard(params_local, xs):
+        # params_local leaves: [1, ...] (this stage's slice) -> squeeze
+        params = jax.tree_util.tree_map(lambda a: a[0], params_local)
+        stage = lax.axis_index(axis_name)
+        M = xs.shape[0]
+        T = M + S - 1  # fill + steady + drain ticks
+        fwd = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            act, outbuf = carry
+            # stage 0 ingests microbatch t (clamped during drain)
+            micro = lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, M - 1), axis=0, keepdims=False
+            )
+            inp = jnp.where(stage == 0, micro, act)
+            out = stage_fn(params, inp)
+            # the LAST stage emits microbatch t-(S-1) once the pipe is full
+            widx = t - (S - 1)
+            updated = lax.dynamic_update_slice_in_dim(
+                outbuf, out[None].astype(outbuf.dtype),
+                jnp.clip(widx, 0, M - 1), axis=0,
+            )
+            valid = jnp.logical_and(stage == S - 1, widx >= 0)
+            outbuf = jnp.where(valid, updated, outbuf)
+            act = lax.ppermute(out, axis_name, fwd)
+            return (act, outbuf), None
+
+        act0 = jnp.zeros_like(xs[0])
+        outbuf0 = jnp.zeros_like(xs)
+        (act, outbuf), _ = lax.scan(tick, (act0, outbuf0), jnp.arange(T))
+        # only the last stage holds real outputs; psum replicates them
+        outbuf = jnp.where(stage == S - 1, outbuf, 0.0)
+        return lax.psum(outbuf, axis_name)
+
+    def pipelined(stacked_params, xs):
+        param_specs = jax.tree_util.tree_map(
+            lambda a: P(axis_name, *([None] * (a.ndim - 1))), stacked_params
+        )
+        fn = shard_map(
+            per_shard, mesh,
+            in_specs=(param_specs, P()),
+            out_specs=P(),
+        )
+        return fn(stacked_params, xs)
+
+    return pipelined
+
+
+def stack_stage_params(param_trees) -> object:
+    """Stack S per-stage parameter pytrees into one tree with a leading
+    stage dimension (the layout :func:`pipeline` expects)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *param_trees
+    )
