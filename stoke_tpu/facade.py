@@ -1124,6 +1124,83 @@ class Stoke:
     def fsdp(self) -> bool:
         return self._status_obj.fsdp
 
+    # ----- reference-parity aliases & config accessors (stoke.py:1271-1466,
+    #       status.py:473-627) -----
+
+    @property
+    def grad_accum(self) -> int:
+        """Alias of grad_accum_steps (reference ``grad_accum`` property)."""
+        return self._status_obj.grad_accum
+
+    @property
+    def sharded(self) -> bool:
+        """Gradient sharding active (reference ``sharded`` ≈ SDDP)."""
+        return self._status_obj.sddp
+
+    @property
+    def fully_sharded(self) -> bool:
+        """Parameter sharding active (reference ``fully_sharded`` ≈ FSDP)."""
+        return self._status_obj.fsdp
+
+    @property
+    def tpu(self) -> bool:
+        """Running on the TPU backend (reference ``gpu``/``cuda`` probes)."""
+        return self._status_obj.is_tpu
+
+    @property
+    def is_fp16(self) -> bool:
+        return self._status_obj.precision is PrecisionOptions.fp16
+
+    @property
+    def is_bf16(self) -> bool:
+        return self._status_obj.precision is PrecisionOptions.bf16
+
+    @property
+    def precision_config(self):
+        """(reference amp_config/apex_config, status.py:473-627)"""
+        return self._status_obj.precision_config
+
+    @property
+    def dp_config(self):
+        """(reference ddp_config/horovod_config/deepspeed_config)"""
+        return self._status_obj.dp_config
+
+    @property
+    def mesh_config(self):
+        return self._status_obj.mesh_config
+
+    @property
+    def oss_config(self):
+        return self._status_obj.oss_config
+
+    @property
+    def sddp_config(self):
+        return self._status_obj.sddp_config
+
+    @property
+    def fsdp_config(self):
+        return self._status_obj.fsdp_config
+
+    @property
+    def checkpoint_config(self):
+        return self._status_obj.checkpoint_config
+
+    @property
+    def profiler_config(self):
+        return self._status_obj.profiler_config
+
+    def reset_ema(self) -> None:
+        """Restart the EMA loss series (reference ``reset_ema``)."""
+        self._rolling_mean_loss = self._zero_scalar()
+        self._ema_initialized = False
+
+    def reset_tracking(self) -> None:
+        """Clear all loss tracking: EMA + accumulated window (reference
+        ``reset_tracking``)."""
+        self.reset_ema()
+        self._reset_tracking_window()
+        self._last_step_loss = None
+
     def num_model_parameters(
         self, normalize: Optional[ParamNormalize] = None
     ) -> float:

@@ -16,6 +16,11 @@ from stoke_tpu.models.bert import (
     dense_attention,
 )
 from stoke_tpu.models.gpt import GPT, GPTBase, GPTTiny, causal_lm_loss
+from stoke_tpu.models.moe import (
+    MoEFFN,
+    MoETransformerBlock,
+    moe_expert_parallel_rules,
+)
 from stoke_tpu.models.resnet import (
     ResNet,
     ResNet18,
@@ -38,6 +43,9 @@ __all__ = [
     "GPTBase",
     "GPTTiny",
     "causal_lm_loss",
+    "MoEFFN",
+    "MoETransformerBlock",
+    "moe_expert_parallel_rules",
     "ResNet",
     "ResNet18",
     "ResNet34",

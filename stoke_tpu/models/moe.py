@@ -1,0 +1,134 @@
+"""Mixture-of-Experts FFN with expert parallelism.
+
+Completes the parallelism menu (dp/tp/sp/pp/**ep**) — capability upside
+beyond the reference (SURVEY.md §2.8: no expert parallelism).  The design
+keeps the framework's theme: expert parallelism is *placement*, not code.
+Expert weights are stacked on a leading expert dimension; shard that
+dimension over a mesh ``expert`` axis with a partition rule
+(:func:`moe_expert_parallel_rules`) and GSPMD lowers the dispatch/combine
+einsums to the all-to-all pattern — no hand-written collectives.
+
+Routing is standard switch-style top-1 with a capacity limit: each token
+goes to its argmax expert; experts accept at most
+``ceil(tokens/E) * capacity_factor`` tokens; overflow tokens pass through
+the residual unchanged (combine weight 0).  Dispatch/combine are one-hot
+einsums (MXU-friendly, static shapes — no gather/scatter).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class MoEFFN(nn.Module):
+    """Switch-routed expert FFN block (drop-in for a dense FFN).
+
+    Args:
+        hidden: model width (input/output dim).
+        ff: per-expert feed-forward width.
+        num_experts: expert count E (shard over the mesh ``expert`` axis via
+            :func:`moe_expert_parallel_rules` for EP).
+        capacity_factor: per-expert capacity = ceil(N/E) * factor.
+        router_noise: train-time logit jitter (load balancing aid); needs the
+            ``router`` rng stream when > 0.
+    """
+
+    hidden: int
+    ff: int
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+    router_noise: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        B, L, H = x.shape
+        N = B * L
+        E = self.num_experts
+        C = int(np.ceil(N / E) * self.capacity_factor)
+        tokens = x.reshape(N, H)
+
+        logits = nn.Dense(E, use_bias=False, name="router")(tokens)
+        if self.router_noise > 0.0 and train:
+            key = self.make_rng("router")
+            logits = logits + self.router_noise * jax.random.normal(
+                key, logits.shape, logits.dtype
+            )
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)  # [N]
+        gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+
+        # capacity: position of each token within its expert's queue
+        assign = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [N, E]
+        position = (jnp.cumsum(assign, axis=0) - 1.0) * assign  # [N, E]
+        pos_in_expert = jnp.sum(position, axis=-1)  # [N]
+        keep = pos_in_expert < C
+        gate = gate * keep
+
+        # dispatch/combine tensors: [N, E, C] one-hot (static shapes, MXU)
+        pos_oh = jax.nn.one_hot(pos_in_expert, C, dtype=jnp.float32)
+        dispatch = assign[:, :, None] * pos_oh[:, None, :] * keep[:, None, None]
+        combine = dispatch * gate[:, None, None]
+
+        # route → expert MLPs (weights stacked on the expert dim) → return
+        expert_in = jnp.einsum(
+            "nec,nh->ech", dispatch.astype(x.dtype), tokens
+        )  # [E, C, H]
+        w_in = self.param(
+            "w_in", nn.initializers.lecun_normal(), (E, H, self.ff), jnp.float32
+        ).astype(x.dtype)
+        w_out = self.param(
+            "w_out", nn.initializers.lecun_normal(), (E, self.ff, H), jnp.float32
+        ).astype(x.dtype)
+        h = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", expert_in, w_in))
+        expert_out = jnp.einsum("ecf,efh->ech", h, w_out)  # [E, C, H]
+        out = jnp.einsum(
+            "nec,ech->nh", combine.astype(x.dtype), expert_out
+        )
+        return out.reshape(B, L, H)
+
+
+def moe_expert_parallel_rules(expert_axis: str = "expert") -> Tuple:
+    """Partition rules sharding the stacked expert weights over the mesh
+    ``expert`` axis (for ``PartitionRulesConfig``); the router stays
+    replicated.  With these placements GSPMD lowers the dispatch/combine
+    einsums to the expert all-to-all."""
+    return (
+        (r"w_in$", (expert_axis, None, None)),
+        (r"w_out$", (expert_axis, None, None)),
+    )
+
+
+class MoETransformerBlock(nn.Module):
+    """Transformer block whose FFN is a switch MoE (attention unchanged) —
+    composes with the BERT/GPT encoders via manual stacking or as a
+    reference for building MoE models."""
+
+    hidden: int
+    heads: int
+    ff: int
+    num_experts: int = 8
+    dropout_rate: float = 0.1
+    capacity_factor: float = 1.25
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, bias, deterministic: bool):
+        from stoke_tpu.models.bert import MultiHeadAttention, dense_attention
+
+        attn = self.attention_fn or dense_attention
+        y = MultiHeadAttention(
+            self.hidden, self.heads, self.dropout_rate, attn, name="attention"
+        )(x, bias, deterministic)
+        y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
+        x = nn.LayerNorm(epsilon=1e-12, name="ln_attn")(x + y)
+        y = MoEFFN(
+            self.hidden, self.ff, self.num_experts, self.capacity_factor,
+            name="moe",
+        )(x, train=not deterministic)
+        y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
+        return nn.LayerNorm(epsilon=1e-12, name="ln_ff")(x + y)

@@ -291,6 +291,29 @@ def test_properties_and_introspection(rng, capsys):
     assert s.optimizer is not None
 
 
+def test_reference_parity_accessors(rng):
+    """The reference's property surface (stoke.py:1271-1466) maps over."""
+    from stoke_tpu.configs import PrecisionConfig
+
+    s = make_stoke(grad_accum=2, precision="bf16")
+    assert s.grad_accum == 2
+    assert s.sharded is False and s.fully_sharded is False
+    assert s.tpu is False
+    assert s.is_bf16 and not s.is_fp16
+    assert isinstance(s.precision_config, PrecisionConfig)
+    assert s.dp_config.axis_name == "data"
+    assert s.mesh_config.axes == ("data",)
+    assert s.oss_config and s.sddp_config and s.fsdp_config
+    assert s.checkpoint_config and s.profiler_config
+    x, y = batch(rng)
+    s.backward(s.loss(s.model(x), y))
+    assert s.ema_loss > 0
+    s.reset_ema()
+    assert float(jax.device_get(s._rolling_mean_loss)) == 0.0
+    s.reset_tracking()
+    assert s.step_loss is None and s.mean_accumulated_loss is None
+
+
 def test_reset(rng):
     s = make_stoke(grad_accum=4)
     x, y = batch(rng)
