@@ -1195,11 +1195,17 @@ class Stoke:
         self._ema_initialized = False
 
     def reset_tracking(self) -> None:
-        """Clear all loss tracking: EMA + accumulated window (reference
-        ``reset_tracking``)."""
+        """Clear all loss tracking AND step counters (reference
+        ``reset_tracking``, stoke.py:1209-1221, zeroes the counters too);
+        the partial gradient window is discarded with them."""
         self.reset_ema()
         self._reset_tracking_window()
         self._last_step_loss = None
+        self._grad_accum_counter = 0
+        self._optimizer_steps = 0
+        self._backward_steps = 0
+        self._pending = None
+        self._grad_buf = self._engine.init_grad_buffer(self._variables)
 
     def num_model_parameters(
         self, normalize: Optional[ParamNormalize] = None

@@ -46,50 +46,56 @@ class MoEFFN(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        B, L, H = x.shape
-        N = B * L
+        # Grouped dispatch (Switch/Mesh-TF layout): tokens are routed within
+        # per-example groups of S = L tokens, so the one-hot dispatch/combine
+        # tensors are [G, S, E, C] with C ≈ S/E — LINEAR in total tokens
+        # (an ungrouped [N, E, N/E] layout would be quadratic and OOM at
+        # real sequence lengths).
+        G, S, H = x.shape
         E = self.num_experts
-        C = int(np.ceil(N / E) * self.capacity_factor)
-        tokens = x.reshape(N, H)
+        C = max(1, int(np.ceil(S / E) * self.capacity_factor))
 
-        logits = nn.Dense(E, use_bias=False, name="router")(tokens)
+        logits = nn.Dense(E, use_bias=False, name="router")(x)  # [G, S, E]
         if self.router_noise > 0.0 and train:
             key = self.make_rng("router")
             logits = logits + self.router_noise * jax.random.normal(
                 key, logits.shape, logits.dtype
             )
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        expert_idx = jnp.argmax(probs, axis=-1)  # [N]
-        gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+        expert_idx = jnp.argmax(probs, axis=-1)  # [G, S]
+        gate = jnp.take_along_axis(probs, expert_idx[..., None], axis=-1)[..., 0]
 
-        # capacity: position of each token within its expert's queue
-        assign = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [N, E]
-        position = (jnp.cumsum(assign, axis=0) - 1.0) * assign  # [N, E]
-        pos_in_expert = jnp.sum(position, axis=-1)  # [N]
+        # capacity: position of each token within its expert's per-group queue
+        assign = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [G, S, E]
+        position = (jnp.cumsum(assign, axis=1) - 1.0) * assign
+        pos_in_expert = jnp.sum(position, axis=-1)  # [G, S]
         keep = pos_in_expert < C
         gate = gate * keep
 
-        # dispatch/combine tensors: [N, E, C] one-hot (static shapes, MXU)
-        pos_oh = jax.nn.one_hot(pos_in_expert, C, dtype=jnp.float32)
-        dispatch = assign[:, :, None] * pos_oh[:, None, :] * keep[:, None, None]
-        combine = dispatch * gate[:, None, None]
+        # dispatch/combine: [G, S, E, C] one-hot (static shapes, MXU)
+        pos_oh = jax.nn.one_hot(
+            pos_in_expert.astype(jnp.int32), C, dtype=jnp.float32
+        )
+        dispatch = (
+            assign[..., None] * pos_oh[:, :, None, :] * keep[..., None, None]
+        )
+        combine = dispatch * gate[..., None, None]
 
         # route → expert MLPs (weights stacked on the expert dim) → return
         expert_in = jnp.einsum(
-            "nec,nh->ech", dispatch.astype(x.dtype), tokens
-        )  # [E, C, H]
+            "gsec,gsh->egch", dispatch.astype(x.dtype), x
+        )  # [E, G, C, H]
         w_in = self.param(
             "w_in", nn.initializers.lecun_normal(), (E, H, self.ff), jnp.float32
         ).astype(x.dtype)
         w_out = self.param(
             "w_out", nn.initializers.lecun_normal(), (E, self.ff, H), jnp.float32
         ).astype(x.dtype)
-        h = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", expert_in, w_in))
-        expert_out = jnp.einsum("ecf,efh->ech", h, w_out)  # [E, C, H]
-        out = jnp.einsum(
-            "nec,ech->nh", combine.astype(x.dtype), expert_out
+        h = jax.nn.gelu(jnp.einsum("egch,ehf->egcf", expert_in, w_in))
+        expert_out = jnp.einsum("egcf,efh->egch", h, w_out)
+        return jnp.einsum(
+            "gsec,egch->gsh", combine.astype(x.dtype), expert_out
         )
-        return out.reshape(B, L, H)
 
 
 def moe_expert_parallel_rules(expert_axis: str = "expert") -> Tuple:

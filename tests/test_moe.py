@@ -31,7 +31,7 @@ def test_routing_sends_tokens_to_argmax_expert(rng):
     moe = make_moe()
     x = jnp.asarray(rng.normal(size=(B, L, H)).astype(np.float32))
     v = init_module(moe, jax.random.PRNGKey(0), x, train=False)
-    params = jax.tree_util.tree_map(lambda a: a, v["params"])
+    params = v["params"]
 
     out = moe.apply({"params": params}, x, train=False)
     assert out.shape == (B, L, H)
