@@ -419,6 +419,10 @@ class Stoke:
         placed = self._place_batch(full)
         report = self._engine.loss_eval(placed, treedef)
         if self._training:
+            # this loss produced NO gradients; drop any stale pending buffer
+            # so a following backward() errors instead of committing grads
+            # from an earlier, unrelated loss() call
+            self._pending = None
             # keep the fused-path convention: training losses are returned
             # divided by grad_accum (reference stoke.py:901-911)
             inv = 1.0 / self._status_obj.grad_accum

@@ -120,6 +120,18 @@ def test_no_backward_no_grads(rng):
     )
 
 
+def test_materialized_loss_clears_stale_pending(rng):
+    """loss() on materialized arrays produces no grads; a following
+    backward() must error rather than commit an earlier call's gradients."""
+    s = make_stoke()
+    x, y = batch(rng)
+    s.loss(s.model(x), y)  # creates pending grads (uncommitted)
+    out2 = s.model(x)
+    l2 = s.loss(out2.value, y)  # materialized → loss-only, no grads
+    with pytest.raises(RuntimeError):
+        s.backward(l2)
+
+
 def test_backward_without_loss_raises(rng):
     s = make_stoke()
     with pytest.raises(RuntimeError):
