@@ -138,6 +138,21 @@ def test_grad_accum_distributed(devices):
     assert s.optimizer_steps == 2
 
 
+def test_fp16_scaler_with_sharded_tiers(devices):
+    """The functional loss scaler works under oss+sddp sharding (the
+    reference needs a special ShardedGradScaler here, fp16.py:731-748)."""
+    s = make(distributed="dp", oss=True, sddp=True, precision="fp16")
+    r = np.random.default_rng(3)
+    W = r.normal(size=(IN, OUT)).astype(np.float32)
+    for _ in range(3):
+        x = r.normal(size=(32, IN)).astype(np.float32)
+        s.backward(s.loss(s.model(x), (x @ W).astype(np.float32)))
+        s.step()
+    assert s.optimizer_steps == 3
+    assert s.skipped_optimizer_steps == 0.0
+    assert s.loss_scale == 2.0**16  # no overflow, interval not reached
+
+
 def test_window_step_distributed_matches(devices):
     """Scanned window step on the sharded mesh == per-micro 4-call steps."""
     r = np.random.default_rng(3)

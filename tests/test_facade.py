@@ -218,6 +218,41 @@ def test_multi_loss_tuple(rng):
     )
 
 
+def test_multi_loss_dict(rng):
+    """Dict-valued losses report per-key and train on the sum."""
+
+    def dict_loss(out, y):
+        return {"mse": jnp.mean((out - y) ** 2), "reg": 0.01 * jnp.mean(out**2)}
+
+    s = make_stoke(loss=dict_loss)
+    x, y = batch(rng)
+    l = s.loss(s.model(x), y)
+    assert set(l) == {"mse", "reg"}
+    s.backward(l)
+    s.step()
+    assert s.optimizer_steps == 1
+    assert s.step_loss == pytest.approx(float(l["mse"]) + float(l["reg"]), rel=1e-5)
+
+
+def test_deferred_dict_output_key_access(rng):
+    """Models returning dicts: out['logits'] routes through the fused step."""
+
+    def dict_model(params, x):
+        h = x @ params["w"] + params["b"]
+        return {"logits": h, "features": h * 2}
+
+    s = make_stoke(model=dict_model)
+    x, y = batch(rng)
+    out = s.model(x)
+    l = s.loss(out["logits"], y)
+    s.backward(l)
+    s.step()
+    assert s.optimizer_steps == 1
+    np.testing.assert_allclose(
+        np.asarray(out["features"]), 2 * np.asarray(out["logits"]), rtol=1e-5
+    )
+
+
 def test_grad_clip_value_effect(rng):
     """With a harsh value clip, the SGD update is bounded by lr*clip."""
     s = make_stoke(
