@@ -571,6 +571,13 @@ class Stoke:
         ):
             self.save(cfg.auto_path, name=cfg.auto_name)
 
+    def wait_for_checkpoint(self) -> None:
+        """Block until in-flight async checkpoint saves finish
+        (``CheckpointConfig(async_save=True)``)."""
+        from stoke_tpu import io_ops
+
+        io_ops.wait_for_saves()
+
     def maybe_resume(self, path: Optional[str] = None) -> bool:
         """Resume from the newest auto-checkpoint if one exists; otherwise
         start fresh.  Returns True when a checkpoint was loaded.  Combined

@@ -29,7 +29,10 @@ import os
 import pickle
 import re
 import shutil
+import threading
 from typing import Any, Dict, Optional
+
+_ASYNC_SAVES: list = []  # in-flight background save threads
 
 import jax
 import numpy as np
@@ -159,26 +162,75 @@ def save_checkpoint(
     }
     if grad_buf is not None:
         state["grad_buf"] = grad_buf
+    def _write_meta():
+        if jax.process_index() == 0:
+            meta = {
+                "format": config.format.value,
+                "counters": counters,
+                "status": status,
+                "name": name,
+            }
+            with open(os.path.join(tag_dir, "meta.json"), "w") as f:
+                json.dump(meta, f, indent=2, default=str)
+            if extras:
+                with open(os.path.join(tag_dir, "extras.pkl"), "wb") as f:
+                    pickle.dump(extras, f)
+            _prune_old(root, name, config.max_to_keep)
+            unrolled_print(f"Saved checkpoint {tag_dir}")
+
+    if config.async_save and not _is_multiprocess():
+        # Async save: the device→host gather happens HERE, synchronously —
+        # the compiled steps donate (invalidate) state buffers, so a
+        # background thread must never touch device arrays.  Only the slow
+        # part (serialization + disk) runs in the thread.  meta.json is
+        # written last so a crash mid-save never leaves a loadable partial
+        # tag (load requires meta.json).  Multi-process saves stay
+        # synchronous (gather collectives must run on the main thread).
+        host_state = {k: _gather_to_host(v) for k, v in state.items()}
+
+        def _bg():
+            for key, tree in host_state.items():
+                leaves, _ = _flat_arrays(tree)
+                np.savez(
+                    os.path.join(tag_dir, f"{key}.npz"),
+                    **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)},
+                )
+            # async writes use the consolidated layout regardless of the
+            # configured format; record that so load() reads it back right
+            nonlocal_config_format = CheckpointFormat.consolidated
+            if jax.process_index() == 0:
+                meta = {
+                    "format": nonlocal_config_format.value,
+                    "counters": counters,
+                    "status": status,
+                    "name": name,
+                }
+                with open(os.path.join(tag_dir, "meta.json"), "w") as f:
+                    json.dump(meta, f, indent=2, default=str)
+                if extras:
+                    with open(os.path.join(tag_dir, "extras.pkl"), "wb") as f:
+                        pickle.dump(extras, f)
+                _prune_old(root, name, config.max_to_keep)
+                unrolled_print(f"Saved checkpoint {tag_dir} (async)")
+
+        t = threading.Thread(target=_bg, name=f"stoke-save-{tag}", daemon=False)
+        _ASYNC_SAVES.append(t)
+        t.start()
+        return tag_dir
     if config.format is CheckpointFormat.consolidated:
         _save_consolidated(tag_dir, state)
     else:
         _save_sharded(tag_dir, state)
-    if jax.process_index() == 0:
-        meta = {
-            "format": config.format.value,
-            "counters": counters,
-            "status": status,
-            "name": name,
-        }
-        with open(os.path.join(tag_dir, "meta.json"), "w") as f:
-            json.dump(meta, f, indent=2, default=str)
-        if extras:
-            with open(os.path.join(tag_dir, "extras.pkl"), "wb") as f:
-                pickle.dump(extras, f)
-        _prune_old(root, name, config.max_to_keep)
-        unrolled_print(f"Saved checkpoint {tag_dir}")
+    _write_meta()
     _barrier()
     return tag_dir
+
+
+def wait_for_saves() -> None:
+    """Block until all in-flight async checkpoint saves complete (call
+    before exiting or before loading a just-saved checkpoint)."""
+    while _ASYNC_SAVES:
+        _ASYNC_SAVES.pop().join()
 
 
 def _prune_old(root: str, name: str, max_to_keep: Optional[int]) -> None:

@@ -214,6 +214,24 @@ def test_auto_save_and_maybe_resume(tmp_path):
     )
 
 
+def test_async_save_roundtrip(tmp_path):
+    """async_save writes in the background; wait_for_checkpoint() then load
+    yields the exact state at save time (immutable array snapshots)."""
+    from stoke_tpu import CheckpointConfig
+
+    s = train_a_bit(make(configs=[CheckpointConfig(async_save=True)]), steps=2)
+    path = str(tmp_path / "ckpt")
+    s.save(path)
+    w_at_save = np.asarray(s.params["w1"]).copy()
+    s = train_a_bit(s, steps=2)  # keep training while the save runs
+    s.wait_for_checkpoint()
+
+    s2 = make()
+    s2.load(path)
+    assert s2.optimizer_steps == 2
+    np.testing.assert_allclose(np.asarray(s2.params["w1"]), w_at_save, rtol=1e-6)
+
+
 def test_structure_mismatch_rejected(tmp_path):
     s = train_a_bit(make())
     path = str(tmp_path / "ckpt")
