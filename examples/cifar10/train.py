@@ -158,9 +158,14 @@ def main():
     if args.epochs is not None:
         cfg["epochs"] = args.epochs
 
+    from stoke_tpu import ArrayDataset
+
     stoke = build_stoke(cfg)
-    train_ds = CIFAR10(args.data, train=True, n_synth=args.synthetic_n)
-    test_ds = CIFAR10(args.data, train=False, n_synth=args.synthetic_n // 5)
+    train_raw = CIFAR10(args.data, train=True, n_synth=args.synthetic_n)
+    test_raw = CIFAR10(args.data, train=False, n_synth=args.synthetic_n // 5)
+    # ArrayDataset routes batch assembly through the native C++ batcher
+    train_ds = ArrayDataset(train_raw.x, train_raw.y)
+    test_ds = ArrayDataset(test_raw.x, test_raw.y)
     train_loader = stoke.DataLoader(train_ds, shuffle=True, drop_last=True)
     test_loader = stoke.DataLoader(test_ds, drop_last=True)
 

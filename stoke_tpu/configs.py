@@ -218,6 +218,11 @@ class DataParallelConfig:
     sync_batch_stats: bool = True
     loss_reduction: LossReduction = LossReduction.mean
     convert_to_sync_batchnorm: bool = False
+    # opt-in: also shard this batch dim over the mesh "seq" axis when one
+    # exists (pre-shards inputs for sequence-parallel attention instead of
+    # relying on GSPMD resharding at the shard_map boundary)
+    shard_seq_dim: Optional[int] = None
+    seq_axis_name: str = "seq"
 
 
 @dataclass

@@ -294,6 +294,19 @@ class Stoke:
         if len(shape) > batch_dim and shape[batch_dim] % self._mesh.shape[axis] == 0:
             spec = [None] * (batch_dim + 1)
             spec[batch_dim] = axis
+            # opt-in sequence-dim sharding (DataParallelConfig.shard_seq_dim):
+            # pre-place inputs for sequence-parallel attention
+            cfg = self._status_obj.dp_config
+            sd = cfg.shard_seq_dim
+            if (
+                sd is not None
+                and cfg.seq_axis_name in self._mesh.axis_names
+                and len(shape) > sd
+                and sd != batch_dim
+                and shape[sd] % self._mesh.shape[cfg.seq_axis_name] == 0
+            ):
+                spec += [None] * (sd + 1 - len(spec))
+                spec[sd] = cfg.seq_axis_name
             return NamedSharding(self._mesh, P(*spec))
         return NamedSharding(self._mesh, P())
 

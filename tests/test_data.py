@@ -150,6 +150,19 @@ def test_loader_no_place_passthrough():
     assert isinstance(b, np.ndarray)
 
 
+def test_loader_torch_workers():
+    """Multi-worker host loading through the torch path still yields numpy
+    batches in order."""
+    dl = StokeDataLoader(
+        SizedDataset(64), batch_size=16, place_fn=None, num_workers=2,
+        shuffle=False,
+    )
+    batches = list(dl)
+    assert len(batches) == 4
+    assert isinstance(batches[0], np.ndarray)
+    np.testing.assert_allclose(batches[0][0], [0.0, 0.5])
+
+
 def test_loader_prefetch_order_preserved():
     dl = StokeDataLoader(
         SizedDataset(64), batch_size=8, place_fn=lambda b: b, prefetch=3, shuffle=False

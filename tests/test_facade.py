@@ -487,6 +487,55 @@ def test_profile_trace_writes(tmp_path, rng):
     assert any(os.scandir(str(tmp_path)))  # trace files exist
 
 
+def test_activation_checkpointing_matches(rng):
+    """Remat through the facade: identical numerics, opt-in via config."""
+    from stoke_tpu import ActivationCheckpointingConfig
+
+    batches = [batch(rng) for _ in range(3)]
+    s1 = make_stoke()
+    s2 = make_stoke(
+        configs=[ActivationCheckpointingConfig(policy="nothing_saveable")]
+    )
+    for x, y in batches:
+        s1.train_step(x, y)
+        s2.train_step(x, y)
+    np.testing.assert_allclose(
+        np.asarray(s1.params["w"]), np.asarray(s2.params["w"]), rtol=1e-6
+    )
+
+
+def test_seq_dim_batch_sharding(rng):
+    """Opt-in sequence-dim sharding places [B, L, ...] batches over
+    ("data","seq") (DataParallelConfig.shard_seq_dim)."""
+    from jax.sharding import PartitionSpec as P
+
+    from stoke_tpu import DataParallelConfig, MeshConfig
+
+    def seq_model(params, x):
+        return jnp.einsum("bld,dk->blk", x, params["w"])
+
+    s = Stoke(
+        model=seq_model,
+        optimizer=StokeOptimizer(
+            optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.1}
+        ),
+        loss=lambda o, y: jnp.mean((o - y) ** 2),
+        params={"w": jnp.zeros((4, 2))},
+        batch_size_per_device=2,
+        distributed="dp",
+        configs=[
+            MeshConfig(axes=("data", "seq"), shape=(2, 4)),
+            DataParallelConfig(shard_seq_dim=1),
+        ],
+        verbose=False,
+    )
+    x = np.zeros((4, 8, 4), np.float32)  # B=4 (÷2), L=8 (÷4)
+    placed = s._place_batch(x)
+    assert placed.sharding.spec == P("data", "seq")
+    y1d = s._place_batch(np.zeros((4,), np.float32))  # no seq dim
+    assert y1d.sharding.spec == P("data")
+
+
 def test_wall_clock_breakdown(rng):
     from stoke_tpu import ProfilerConfig
 
