@@ -291,6 +291,9 @@ class Stoke:
         if self._mesh is None:
             return self._device
         axis = self._rules.axis_name
+        if axis not in self._mesh.axis_names:
+            # mesh without a dp axis (pure pipeline/TP): batch replicated
+            return NamedSharding(self._mesh, P())
         if len(shape) > batch_dim and shape[batch_dim] % self._mesh.shape[axis] == 0:
             spec = [None] * (batch_dim + 1)
             spec[batch_dim] = axis

@@ -21,6 +21,7 @@ from stoke_tpu.models.moe import (
     MoETransformerBlock,
     moe_expert_parallel_rules,
 )
+from stoke_tpu.models.pipelined_lm import PipelinedLM, pipeline_parallel_rules
 from stoke_tpu.models.resnet import (
     ResNet,
     ResNet18,
@@ -46,6 +47,8 @@ __all__ = [
     "MoEFFN",
     "MoETransformerBlock",
     "moe_expert_parallel_rules",
+    "PipelinedLM",
+    "pipeline_parallel_rules",
     "ResNet",
     "ResNet18",
     "ResNet34",

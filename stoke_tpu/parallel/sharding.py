@@ -99,15 +99,30 @@ def sharding_tree(
             p = _path_str(path)
             for rx, spec in overrides:
                 if rx.search(p):
-                    if len(spec) != len(shape):
+                    entries = tuple(spec)
+                    if entries and entries[-1] is Ellipsis:
+                        # variadic rule: pad the remaining dims with None
+                        # (e.g. ("stage", ...) for stage-stacked trees whose
+                        # leaves have mixed ranks)
+                        head = entries[:-1]
+                        if len(head) > len(shape):
+                            if strict_overrides:
+                                raise ValueError(
+                                    f"Stoke -- partition rule {rx.pattern!r} "
+                                    f"needs at least {len(head)} dims but "
+                                    f"parameter {p} has shape {shape}"
+                                )
+                            break
+                        entries = head + (None,) * (len(shape) - len(head))
+                    if len(entries) != len(shape):
                         if strict_overrides:
                             raise ValueError(
                                 f"Stoke -- partition rule {rx.pattern!r} has "
-                                f"{len(spec)} entries but parameter {p} has "
+                                f"{len(entries)} entries but parameter {p} has "
                                 f"shape {shape}"
                             )
                         break
-                    return NamedSharding(mesh, spec)
+                    return NamedSharding(mesh, P(*entries))
         return NamedSharding(mesh, spec_fn(shape))
 
     return jax.tree_util.tree_map_with_path(_spec_for, tree_shapes)
@@ -170,12 +185,22 @@ class ShardingRules:
 
 
 def compile_partition_rules(rules) -> Optional[list]:
-    """Compile (regex, spec-tuple) pairs into (pattern, PartitionSpec)."""
+    """Compile (regex, spec-tuple) pairs into (pattern, entries-tuple).
+
+    A trailing ``...`` (or the string ``"..."``, for YAML) makes the rule
+    variadic: remaining dims are replicated — for trees whose leaves have
+    mixed ranks (e.g. stage-stacked pipeline parameters)."""
     import re
 
     if not rules:
         return None
-    return [(re.compile(rx), P(*spec)) for rx, spec in rules]
+    compiled = []
+    for rx, spec in rules:
+        entries = tuple(
+            Ellipsis if e is Ellipsis or e == "..." else e for e in spec
+        )
+        compiled.append((re.compile(rx), entries))
+    return compiled
 
 
 def make_sharding_rules(
@@ -194,7 +219,11 @@ def make_sharding_rules(
     if mesh is None:
         return None
     overrides = compile_partition_rules(partition_rules)
-    size = mesh.shape[axis_name]
+    # a mesh without the dp axis (e.g. pure pipeline: axes=("stage",)) is
+    # legal — state is replicated across it and only partition rules shard
+    size = mesh.shape.get(axis_name, 1) if hasattr(mesh.shape, "get") else (
+        mesh.shape[axis_name] if axis_name in mesh.axis_names else 1
+    )
     repl: Callable[[tuple], P] = lambda shape: P()
     shard_opt = lambda shape: leaf_partition_spec(
         shape, axis_name, size, oss_config.min_shard_size, "largest"
