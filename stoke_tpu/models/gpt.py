@@ -49,6 +49,12 @@ class GPT(nn.Module):
     attention_is_causal: bool = False
     tie_embeddings: bool = True
     remat: bool = False
+    # sparse-FFN option: replace the dense FFN with a switch MoE in every
+    # `moe_every`-th block (0 experts = dense everywhere); shard experts
+    # with moe_expert_parallel_rules() for expert parallelism
+    moe_num_experts: int = 0
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, input_ids, train: bool = True):
@@ -71,13 +77,30 @@ class GPT(nn.Module):
             causal = jnp.tril(jnp.ones((L, L), bool))
             bias = jnp.where(causal, 0.0, -1e9)[None, None, :, :].astype(h.dtype)
         block = TransformerBlock
+        moe_block = None
+        if self.moe_num_experts > 0:
+            from stoke_tpu.models.moe import MoETransformerBlock
+
+            moe_block = MoETransformerBlock
         if self.remat:
             block = nn.remat(TransformerBlock, static_argnums=(3,))
+            if moe_block is not None:
+                moe_block = nn.remat(MoETransformerBlock, static_argnums=(3,))
         for i in range(size.num_layers):
-            h = block(
-                size.hidden, size.heads, size.ff, self.dropout_rate,
-                self.attention_fn, name=f"layer_{i}",
-            )(h, bias, not train)
+            use_moe = (
+                moe_block is not None and (i + 1) % self.moe_every == 0
+            )
+            if use_moe:
+                h = moe_block(
+                    size.hidden, size.heads, size.ff, self.moe_num_experts,
+                    self.dropout_rate, self.moe_capacity_factor,
+                    self.attention_fn, name=f"layer_{i}",
+                )(h, bias, not train)
+            else:
+                h = block(
+                    size.hidden, size.heads, size.ff, self.dropout_rate,
+                    self.attention_fn, name=f"layer_{i}",
+                )(h, bias, not train)
         h = nn.LayerNorm(epsilon=1e-5, name="ln_final")(h)
         if self.tie_embeddings:
             return tok_emb.attend(h)

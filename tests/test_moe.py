@@ -91,6 +91,46 @@ def test_expert_parallel_matches_replicated(rng, devices):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6)
 
 
+def test_gpt_with_moe_layers_and_ep(rng, devices):
+    """GPT(moe_num_experts=E): every 2nd block uses the switch MoE; expert
+    weights shard over the expert axis and the LM trains."""
+    from stoke_tpu.models import GPT, causal_lm_loss
+
+    model = GPT(
+        vocab_size=32, size_name="tiny", max_len=32, dropout_rate=0.0,
+        moe_num_experts=E, moe_every=2, moe_capacity_factor=4.0,
+    )
+    seq = np.tile(np.arange(16, dtype=np.int32), 2)[None, :].repeat(4, 0)
+    v = init_module(model, jax.random.PRNGKey(0), seq, train=False)
+    # tiny has 2 layers -> layer_1 is MoE
+    assert "moe" in v["params"]["layer_1"]
+    assert "moe" not in v["params"]["layer_0"]
+
+    s = Stoke(
+        model=model,
+        optimizer=StokeOptimizer(
+            optimizer=optax.adam, optimizer_kwargs={"learning_rate": 3e-3}
+        ),
+        loss=causal_lm_loss,
+        params=v,
+        batch_size_per_device=1,
+        device="cpu",
+        distributed="dp",
+        configs=[
+            MeshConfig(axes=("data", "expert"), shape=(2, 4)),
+            PartitionRulesConfig(rules=moe_expert_parallel_rules()),
+        ],
+        verbose=False,
+    )
+    assert s.params["layer_1"]["moe"]["w_in"].sharding.spec == P(
+        "expert", None, None
+    )
+    l0 = float(s.train_step(seq, seq))
+    for _ in range(15):
+        l = float(s.train_step(seq, seq))
+    assert l < l0
+
+
 def test_moe_trains_through_facade_with_ep(rng, devices):
     import flax.linen as nn
 
