@@ -55,6 +55,7 @@ class GPT(nn.Module):
     moe_num_experts: int = 0
     moe_every: int = 2
     moe_capacity_factor: float = 1.25
+    moe_router_noise: float = 0.0  # needs the "router" rng stream when > 0
 
     @nn.compact
     def __call__(self, input_ids, train: bool = True):
@@ -81,6 +82,16 @@ class GPT(nn.Module):
         if self.moe_num_experts > 0:
             from stoke_tpu.models.moe import MoETransformerBlock
 
+            if self.moe_every < 1:
+                raise ValueError(
+                    f"GPT: moe_every must be >= 1, got {self.moe_every}"
+                )
+            if size.num_layers // self.moe_every == 0:
+                raise ValueError(
+                    f"GPT: moe_every={self.moe_every} selects no layer in a "
+                    f"{size.num_layers}-layer model — the MoE option would "
+                    f"silently train fully dense"
+                )
             moe_block = MoETransformerBlock
         if self.remat:
             block = nn.remat(TransformerBlock, static_argnums=(3,))
@@ -94,7 +105,8 @@ class GPT(nn.Module):
                 h = moe_block(
                     size.hidden, size.heads, size.ff, self.moe_num_experts,
                     self.dropout_rate, self.moe_capacity_factor,
-                    self.attention_fn, name=f"layer_{i}",
+                    self.attention_fn, self.moe_router_noise,
+                    name=f"layer_{i}",
                 )(h, bias, not train)
             else:
                 h = block(

@@ -121,6 +121,7 @@ class MoETransformerBlock(nn.Module):
     dropout_rate: float = 0.1
     capacity_factor: float = 1.25
     attention_fn: Optional[Callable] = None
+    router_noise: float = 0.0
 
     @nn.compact
     def __call__(self, x, bias, deterministic: bool):
@@ -134,7 +135,7 @@ class MoETransformerBlock(nn.Module):
         x = nn.LayerNorm(epsilon=1e-12, name="ln_attn")(x + y)
         y = MoEFFN(
             self.hidden, self.ff, self.num_experts, self.capacity_factor,
-            name="moe",
+            self.router_noise, name="moe",
         )(x, train=not deterministic)
         y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
         return nn.LayerNorm(epsilon=1e-12, name="ln_ff")(x + y)

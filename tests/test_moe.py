@@ -131,6 +131,34 @@ def test_gpt_with_moe_layers_and_ep(rng, devices):
     assert l < l0
 
 
+def test_gpt_moe_validation(rng):
+    from stoke_tpu.models import GPT
+
+    seq = np.ones((1, 8), np.int32)
+    with pytest.raises(ValueError, match="moe_every must be"):
+        init_module(GPT(vocab_size=16, size_name="tiny", moe_num_experts=2,
+                        moe_every=0),
+                    jax.random.PRNGKey(0), seq, train=False)
+    with pytest.raises(ValueError, match="selects no layer"):
+        init_module(GPT(vocab_size=16, size_name="tiny", moe_num_experts=2,
+                        moe_every=3),  # tiny has 2 layers
+                    jax.random.PRNGKey(0), seq, train=False)
+
+
+def test_gpt_moe_router_noise_plumbs(rng):
+    """router_noise reaches the MoE routers (train-mode forwards vary)."""
+    from stoke_tpu.models import GPT
+
+    model = GPT(vocab_size=32, size_name="tiny", max_len=32, dropout_rate=0.0,
+                moe_num_experts=4, moe_every=2, moe_capacity_factor=1.0,
+                moe_router_noise=5.0)
+    seq = rng.integers(1, 32, size=(2, 16)).astype(np.int32)
+    v = init_module(model, jax.random.PRNGKey(0), seq, train=False)
+    a = model.apply(v, seq, train=True, rngs={"router": jax.random.PRNGKey(1)})
+    b = model.apply(v, seq, train=True, rngs={"router": jax.random.PRNGKey(2)})
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+
+
 def test_moe_trains_through_facade_with_ep(rng, devices):
     import flax.linen as nn
 
