@@ -8,12 +8,24 @@ jax initializes a backend, hence the top-level env mutation.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The ambient environment may have already imported jax (a sitecustomize
+# registering a remote-accelerator PJRT plugin) before this conftest runs,
+# locking in JAX_PLATFORMS and a plugin whose backend init can HANG when the
+# remote tunnel is unreachable.  Force the cpu platform at the config level
+# and drop non-cpu backend factories so the suite never touches the tunnel.
+try:  # pragma: no cover - environment-specific hardening
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
