@@ -36,6 +36,43 @@ def _supervise(argv) -> int:
     (which never imports jax) guarantees the driver always gets its one JSON
     line, even if the measurement process hangs or dies.
     """
+    # fast pre-probe: a wedged remote-TPU tunnel hangs any jax process at
+    # backend init; spend 120s finding that out instead of the full watchdog
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            capture_output=True, text=True, timeout=120,
+        )
+        if probe.returncode != 0:
+            raise RuntimeError(
+                (probe.stderr or "device probe failed").strip().splitlines()[-1][:200]
+            )
+    except subprocess.TimeoutExpired:
+        print(
+            json.dumps(
+                {
+                    "metric": "cifar10_resnet50_bf16_train_throughput",
+                    "value": 0.0,
+                    "unit": "imgs/sec/chip",
+                    "vs_baseline": 0.0,
+                    "error": "device probe timed out (TPU tunnel wedged)",
+                }
+            )
+        )
+        return 1
+    except RuntimeError as e:
+        print(
+            json.dumps(
+                {
+                    "metric": "cifar10_resnet50_bf16_train_throughput",
+                    "value": 0.0,
+                    "unit": "imgs/sec/chip",
+                    "vs_baseline": 0.0,
+                    "error": str(e),
+                }
+            )
+        )
+        return 1
     try:
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--_worker", *argv],
