@@ -99,6 +99,29 @@ def test_replicas_agree_on_slices():
     assert len(combined) == len(set(combined))
 
 
+@pytest.mark.parametrize("n", [1000, 1024, 1111])
+@pytest.mark.parametrize("buckets", [2, 5])
+@pytest.mark.parametrize("replicas", [1, 2, 4])
+@pytest.mark.parametrize("drop_last", [False, True])
+def test_sampler_invariants_grid(n, buckets, replicas, drop_last):
+    """Grid over sampler parameters: every epoch yields exactly len(self)
+    indices in whole batches, and replicas stay disjoint with drop_last."""
+    batch = 8
+    per_rank = []
+    for r in range(replicas):
+        s = make_sampler(n=n, buckets=buckets, batch=batch, replicas=replicas,
+                         rank=r, shuffle=True, seed=7, drop_last=drop_last)
+        idx = list(iter(s))
+        assert len(idx) == len(s)
+        assert len(idx) % batch == 0
+        assert all(0 <= i < n for i in idx)
+        per_rank.append(idx)
+    assert len({len(a) for a in per_rank}) == 1
+    if drop_last:
+        combined = list(itertools.chain(*per_rank))
+        assert len(combined) == len(set(combined))
+
+
 def test_bucket_overlap_residuals():
     base = make_sampler(n=1100, buckets=2, batch=8, replicas=2, drop_last=True)
     overlap = make_sampler(
