@@ -241,6 +241,8 @@ class Stoke:
         self._stashed_model_call: Optional[tuple] = None
         self._pending: Optional[tuple] = None  # (new_grad_buf, token)
 
+        self._replication_warned: set = set()
+
         # ----- wall-clock breakdown (reference wall_clock_breakdown,
         #       configs.py:540; host-side dispatch times — device work is
         #       async, use profile_trace() for device timelines) -----
@@ -294,24 +296,34 @@ class Stoke:
         if axis not in self._mesh.axis_names:
             # mesh without a dp axis (pure pipeline/TP): batch replicated
             return NamedSharding(self._mesh, P())
-        if len(shape) > batch_dim and shape[batch_dim] % self._mesh.shape[axis] == 0:
-            spec = [None] * (batch_dim + 1)
-            spec[batch_dim] = axis
-            # opt-in sequence-dim sharding (DataParallelConfig.shard_seq_dim):
-            # pre-place inputs for sequence-parallel attention
-            cfg = self._status_obj.dp_config
-            sd = cfg.shard_seq_dim
-            if (
-                sd is not None
-                and cfg.seq_axis_name in self._mesh.axis_names
-                and len(shape) > sd
-                and sd != batch_dim
-                and shape[sd] % self._mesh.shape[cfg.seq_axis_name] == 0
-            ):
-                spec += [None] * (sd + 1 - len(spec))
-                spec[sd] = cfg.seq_axis_name
-            return NamedSharding(self._mesh, P(*spec))
-        return NamedSharding(self._mesh, P())
+        if len(shape) <= batch_dim or shape[batch_dim] % self._mesh.shape[axis] != 0:
+            # batch not divisible by the data axis: replicate, but tell the
+            # user once per shape — they're paying full-batch compute on
+            # every device without realizing it
+            if len(shape) > batch_dim and shape not in self._replication_warned:
+                self._replication_warned.add(shape)
+                self.warn(
+                    f"batch leaf shape {shape} is not divisible by the "
+                    f"'{axis}' mesh axis ({self._mesh.shape[axis]}); "
+                    f"replicating it on every device"
+                )
+            return NamedSharding(self._mesh, P())
+        spec = [None] * (batch_dim + 1)
+        spec[batch_dim] = axis
+        # opt-in sequence-dim sharding (DataParallelConfig.shard_seq_dim):
+        # pre-place inputs for sequence-parallel attention
+        cfg = self._status_obj.dp_config
+        sd = cfg.shard_seq_dim
+        if (
+            sd is not None
+            and cfg.seq_axis_name in self._mesh.axis_names
+            and len(shape) > sd
+            and sd != batch_dim
+            and shape[sd] % self._mesh.shape[cfg.seq_axis_name] == 0
+        ):
+            spec += [None] * (sd + 1 - len(spec))
+            spec[sd] = cfg.seq_axis_name
+        return NamedSharding(self._mesh, P(*spec))
 
     def _place_batch(self, tree, batch_dim: int = 0):
         """Host batch → device, sharded over the data axis (the TPU
