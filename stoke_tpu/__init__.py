@@ -44,7 +44,7 @@ from stoke_tpu.engine import (
 )
 from stoke_tpu.facade import Stoke
 from stoke_tpu.status import StokeStatus, StokeValidationError
-from stoke_tpu.utils import init_module
+from stoke_tpu.utils import force_cpu, init_module
 
 __version__ = "0.1.0"
 
@@ -52,6 +52,7 @@ __all__ = [
     "Stoke",
     "StokeStatus",
     "StokeValidationError",
+    "force_cpu",
     "init_module",
     "StokeOptimizer",
     "StokeDataLoader",

@@ -264,11 +264,12 @@ class PrecisionPolicy(NamedTuple):
 
 
 def init_scaler_state(cfg: PrecisionConfig) -> Dict[str, Any]:
-    """Device-side dynamic loss-scaler state (functional GradScaler,
-    reference fp16.py:731-748)."""
+    """Dynamic loss-scaler state (functional GradScaler, reference
+    fp16.py:731-748).  Created as host numpy so construction never touches
+    the default accelerator backend (the facade places it explicitly)."""
     return {
-        "scale": jnp.asarray(cfg.init_scale, jnp.float32),
-        "growth_count": jnp.asarray(0, jnp.int32),
+        "scale": np.float32(cfg.init_scale),
+        "growth_count": np.int32(0),
     }
 
 

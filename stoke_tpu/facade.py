@@ -214,14 +214,21 @@ class Stoke:
             opt_target = self._device
             if st.offload_optimizer_config is not None:
                 opt_target = self._single_device_offload_target()
-            self._opt_state = jax.device_put(
-                self._optimizer.init(self._variables["params"]), opt_target
-            )
+            # optimizer init creates fresh scalars (e.g. the adam count) on
+            # the DEFAULT backend; pin it to this run's device
+            with jax.default_device(self._device):
+                opt_state = self._optimizer.init(self._variables["params"])
+            self._opt_state = jax.device_put(opt_state, opt_target)
         self._grad_buf = self._engine.init_grad_buffer(self._variables)
         self._scaler_state = self._place_scalar_tree(
             init_scaler_state(st.precision_config)
         )
-        self._rng = self._place_scalar_tree(jax.random.PRNGKey(seed))
+        # create the key host-side: PRNGKey dispatches on the DEFAULT
+        # backend, which may be a (possibly unreachable) accelerator even
+        # when this run targets cpu
+        with jax.default_device(jax.devices("cpu")[0]):
+            key = jax.random.PRNGKey(seed)
+        self._rng = self._place_scalar_tree(key)
 
         # ----- counters (reference stoke.py:237-243) -----
         self._grad_accum_counter = 0
@@ -281,7 +288,8 @@ class Stoke:
             raise
 
     def _zero_scalar(self):
-        return self._place_scalar_tree(jnp.float32(0.0))
+        # np scalar: creation must not touch the default accelerator backend
+        return self._place_scalar_tree(np.float32(0.0))
 
     def _place_scalar_tree(self, tree):
         if self._rules is not None:

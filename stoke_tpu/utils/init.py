@@ -14,6 +14,16 @@ from typing import Any
 import jax
 
 
+def force_cpu() -> None:
+    """Restrict THIS process to the JAX CPU backend.
+
+    Call before building a ``Stoke`` when you want a pure-CPU run on a
+    machine whose accelerator backend is broken or unreachable (a wedged
+    remote-TPU tunnel hangs any code that lets JAX enumerate backends).
+    Works even when jax was already imported (config-level, not env)."""
+    jax.config.update("jax_platforms", "cpu")
+
+
 def init_module(module, rng, *args, **kwargs) -> Any:
     """Initialize a flax module's variables host-side in one compiled call.
 
