@@ -442,7 +442,10 @@ class StepEngine:
 
         try:
             probe = jax.tree_util.tree_leaves(opt_shardings)[0]
-            jax.device_put(jnp.zeros((1,), jnp.float32), _to_host(probe))
+            # pin the probe array's creation off the default backend (it may
+            # be a different, even unreachable, accelerator)
+            with jax.default_device(probe.mesh.devices.flat[0]):
+                jax.device_put(jnp.zeros((1,), jnp.float32), _to_host(probe))
             return jax.tree_util.tree_map(_to_host, opt_shardings)
         except Exception:
             if self.offload_optimizer.fallback_to_device:

@@ -275,7 +275,8 @@ class Stoke:
 
         target = SingleDeviceSharding(self._device, memory_kind="pinned_host")
         try:
-            jax.device_put(jnp.zeros((1,), jnp.float32), target)
+            with jax.default_device(self._device):
+                jax.device_put(jnp.zeros((1,), jnp.float32), target)
             return target
         except Exception:
             cfg = self._status_obj.offload_optimizer_config

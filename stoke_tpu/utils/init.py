@@ -17,10 +17,25 @@ import jax
 def force_cpu() -> None:
     """Restrict THIS process to the JAX CPU backend.
 
-    Call before building a ``Stoke`` when you want a pure-CPU run on a
-    machine whose accelerator backend is broken or unreachable (a wedged
-    remote-TPU tunnel hangs any code that lets JAX enumerate backends).
-    Works even when jax was already imported (config-level, not env)."""
+    Call before building a ``Stoke`` (and before ANY jax computation) when
+    you want a pure-CPU run on a machine whose accelerator backend is broken
+    or unreachable (a wedged remote-TPU tunnel hangs any code that lets JAX
+    enumerate backends).  Works even when jax was already imported
+    (config-level, not env) — but NOT once a backend has initialized: the
+    platform restriction would silently be a no-op, so that case raises.
+    """
+    try:
+        from jax._src import xla_bridge as _xb
+
+        initialized = bool(getattr(_xb, "_backends", {}))
+    except Exception:
+        initialized = False
+    if initialized:
+        raise RuntimeError(
+            "stoke_tpu.force_cpu() must run before any JAX computation: a "
+            "backend is already initialized and the platform restriction "
+            "would silently have no effect"
+        )
     jax.config.update("jax_platforms", "cpu")
 
 
