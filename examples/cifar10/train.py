@@ -169,6 +169,12 @@ def main():
     train_loader = stoke.DataLoader(train_ds, shuffle=True, drop_last=True)
     test_loader = stoke.DataLoader(test_ds, drop_last=True)
 
+    if len(train_loader) == 0:
+        raise SystemExit(
+            f"dataset too small: {len(train_ds)} samples yield zero "
+            f"{train_loader.batch_size}-sample global batches; raise "
+            f"--synthetic-n or lower batch_size_per_device"
+        )
     stoke.print_on_devices(
         f"train={len(train_ds)} test={len(test_ds)} "
         f"effective_batch={stoke.effective_batch_size}"
