@@ -32,7 +32,6 @@ distributed.py:648-669 — no ``no_sync`` needed here: nothing eagerly syncs).
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Dict, NamedTuple, Optional, Sequence, Tuple
 
 import jax

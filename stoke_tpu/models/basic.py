@@ -4,7 +4,6 @@
 from __future__ import annotations
 
 import flax.linen as nn
-import jax.numpy as jnp
 
 
 class BasicNN(nn.Module):

@@ -12,7 +12,7 @@ when the attention_fn enforces causality itself.
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable, Optional
+from typing import Callable
 
 import flax.linen as nn
 import jax

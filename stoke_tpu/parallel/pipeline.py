@@ -19,7 +19,6 @@ facade integration (stage-stacked optimizers etc.) composes via
 
 from __future__ import annotations
 
-import functools
 from typing import Callable
 
 import jax
