@@ -27,6 +27,7 @@ from stoke_tpu.configs import (
     PrecisionOptions,
     ProfilerConfig,
     SDDPConfig,
+    TensorboardConfig,
     ShardingOptions,
     StokeOptimizer,
 )
@@ -82,6 +83,7 @@ __all__ = [
     "ActivationCheckpointingConfig",
     "CheckpointConfig",
     "ProfilerConfig",
+    "TensorboardConfig",
     # adapters
     "ModelAdapter",
     "FlaxModelAdapter",

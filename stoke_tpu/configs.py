@@ -444,6 +444,27 @@ class CheckpointConfig:
 
 
 @dataclass
+class TensorboardConfig:
+    """TensorBoard metrics logging (reference DeepspeedTensorboardConfig,
+    configs.py:392-405 — passthrough there, first-class here).
+
+    When supplied, the facade logs loss metrics (EMA, step loss, loss scale,
+    counters) every ``log_every_n_steps`` optimizer steps from process 0,
+    and exposes ``Stoke.log_scalar`` for user metrics.  Device→host metric
+    transfers happen only at the logging cadence, never per micro-batch.
+
+    Attributes:
+        output_path: event-file directory (reference output_path).
+        job_name: subdirectory / run name (reference job_name).
+        log_every_n_steps: optimizer-step cadence for automatic metrics.
+    """
+
+    output_path: str = "tensorboard"
+    job_name: str = "stoke"
+    log_every_n_steps: int = 10
+
+
+@dataclass
 class ProfilerConfig:
     """First-class profiling (SURVEY.md §5: native win over the reference's
     DeepSpeed flops-profiler passthrough, configs.py:252-279).
@@ -497,6 +518,7 @@ ALL_CONFIG_CLASSES: Tuple[type, ...] = (
     ActivationCheckpointingConfig,
     CheckpointConfig,
     ProfilerConfig,
+    TensorboardConfig,
 )
 
 

@@ -249,6 +249,7 @@ class Stoke:
         self._pending: Optional[tuple] = None  # (new_grad_buf, token)
 
         self._replication_warned: set = set()
+        self._tb_writer_obj = None
 
         # ----- wall-clock breakdown (reference wall_clock_breakdown,
         #       configs.py:540; host-side dispatch times — device work is
@@ -511,6 +512,7 @@ class Stoke:
         self._optimizer_steps += 1
         self._grad_accum_counter = 0
         self._reset_tracking_window()
+        self._maybe_log_metrics()
         self._maybe_auto_save()
 
     @_timed("train_step")
@@ -590,10 +592,60 @@ class Stoke:
             self._optimizer_steps += 1
             self._grad_accum_counter = 0
             self._reset_tracking_window()
+            self._maybe_log_metrics()
             self._maybe_auto_save()
         else:
             self._grad_accum_counter += 1
         return report
+
+    # ------------------------------------------------------------------ #
+    # TensorBoard metrics (reference DeepspeedTensorboardConfig,
+    # configs.py:392-405 — passthrough there, first-class here)
+    # ------------------------------------------------------------------ #
+
+    @property
+    def _tb_writer(self):
+        cfg = self._status_obj.tensorboard_config
+        if cfg is None or not self.is_rank_0:
+            return None
+        if self._tb_writer_obj is None:
+            import os
+
+            from torch.utils.tensorboard import SummaryWriter
+
+            self._tb_writer_obj = SummaryWriter(
+                os.path.join(cfg.output_path, cfg.job_name)
+            )
+        return self._tb_writer_obj
+
+    def log_scalar(self, tag: str, value, step: Optional[int] = None) -> None:
+        """Log a user scalar to TensorBoard (no-op without a
+        ``TensorboardConfig`` or off rank 0)."""
+        w = self._tb_writer
+        if w is not None:
+            w.add_scalar(tag, float(value), step if step is not None
+                         else self._optimizer_steps)
+
+    def _maybe_log_metrics(self) -> None:
+        cfg = self._status_obj.tensorboard_config
+        if (
+            cfg is None
+            or self._optimizer_steps == 0
+            or self._optimizer_steps % cfg.log_every_n_steps != 0
+        ):
+            return
+        w = self._tb_writer
+        if w is None:
+            return
+        step = self._optimizer_steps
+        w.add_scalar("loss/ema", self.ema_loss, step)
+        if self._last_step_loss is not None:
+            w.add_scalar("loss/micro", self.step_loss, step)
+        if self._precision.scaled:
+            w.add_scalar("scaler/loss_scale", self.loss_scale, step)
+            w.add_scalar("scaler/skipped_steps", self.skipped_optimizer_steps, step)
+        w.add_scalar("counters/backward_steps", self._backward_steps, step)
+        w.flush()
 
     def _maybe_auto_save(self) -> None:
         """Periodic checkpoint from the step path when
@@ -714,6 +766,7 @@ class Stoke:
             )
         self._optimizer_steps += 1
         self._reset_tracking_window()
+        self._maybe_log_metrics()
         self._maybe_auto_save()
         return reports
 

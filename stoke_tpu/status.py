@@ -42,6 +42,7 @@ from stoke_tpu.configs import (
     ProfilerConfig,
     SDDPConfig,
     ShardingOptions,
+    TensorboardConfig,
     asdict_config,
 )
 
@@ -389,6 +390,12 @@ class StokeStatus:
     @property
     def profiler_config(self) -> ProfilerConfig:
         return self._get_or_default(ProfilerConfig)
+
+    @property
+    def tensorboard_config(self):
+        """None unless explicitly supplied (metrics logging is opt-in,
+        reference configs.py:392-405)."""
+        return self._configs.get("TensorboardConfig")
 
     # ------------------------------------------------------------------ #
     # Serialization / display (reference status.py:629-654)

@@ -573,6 +573,33 @@ def test_offload_optimizer_fallback_trains(rng):
     assert s.optimizer_steps == 5
 
 
+def test_tensorboard_metrics_logging(tmp_path, rng):
+    """TensorboardConfig: automatic loss metrics at the step cadence + user
+    scalars land in event files (reference DeepspeedTensorboardConfig)."""
+    import os
+
+    from stoke_tpu import TensorboardConfig
+
+    s = make_stoke(
+        configs=[TensorboardConfig(output_path=str(tmp_path), job_name="run1",
+                                   log_every_n_steps=2)]
+    )
+    for _ in range(4):
+        x, y = batch(rng)
+        s.train_step(x, y)
+    s.log_scalar("custom/metric", 1.23)
+    s._tb_writer.flush()
+    run_dir = os.path.join(str(tmp_path), "run1")
+    files = os.listdir(run_dir)
+    assert any("tfevents" in f for f in files)
+    assert os.path.getsize(os.path.join(run_dir, files[0])) > 0
+
+
+def test_log_scalar_noop_without_config(rng):
+    s = make_stoke()
+    s.log_scalar("x", 1.0)  # must not raise or create files
+
+
 def test_estimate_step_flops(rng):
     s = make_stoke()
     x, y = batch(rng)
