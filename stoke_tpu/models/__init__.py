@@ -22,6 +22,7 @@ from stoke_tpu.models.moe import (
     moe_expert_parallel_rules,
 )
 from stoke_tpu.models.pipelined_lm import PipelinedLM, pipeline_parallel_rules
+from stoke_tpu.models.vit import ViT, ViTBase, ViTTiny
 from stoke_tpu.models.resnet import (
     ResNet,
     ResNet18,
@@ -49,6 +50,9 @@ __all__ = [
     "moe_expert_parallel_rules",
     "PipelinedLM",
     "pipeline_parallel_rules",
+    "ViT",
+    "ViTBase",
+    "ViTTiny",
     "ResNet",
     "ResNet18",
     "ResNet34",

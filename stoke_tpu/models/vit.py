@@ -1,0 +1,78 @@
+"""Vision Transformer (ViT) classifier.
+
+Rounds out the model library's vision side (CNNs: BasicNN/ResNet; this is
+the transformer counterpart), reusing the shared transformer blocks so every
+attention option (dense, pallas flash, ring/Ulysses) and partition-rule set
+(tensor parallelism via the same qkv/ff rule paths) applies unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from stoke_tpu.models.bert import (
+    BERT_SIZES,
+    BertSize,
+    TransformerBlock,
+    dense_attention,
+)
+
+
+class ViT(nn.Module):
+    """ViT classifier: patchify (conv stem) + CLS token + learned positions +
+    transformer encoder + linear head.
+
+    Args:
+        size_name: width table entry ("tiny"…"large", shared with BERT).
+        patch_size: square patch edge; image H/W must be divisible.
+    """
+
+    num_classes: int = 1000
+    size_name: str = "tiny"
+    patch_size: int = 4
+    dropout_rate: float = 0.1
+    attention_fn: Callable = dense_attention
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        size: BertSize = BERT_SIZES[self.size_name]
+        B, H, W, C = x.shape
+        if H % self.patch_size or W % self.patch_size:
+            raise ValueError(
+                f"ViT: image {H}x{W} not divisible by patch_size={self.patch_size}"
+            )
+        # patchify: one conv with stride = patch size (MXU-friendly)
+        h = nn.Conv(
+            size.hidden, (self.patch_size, self.patch_size),
+            strides=(self.patch_size, self.patch_size), name="patch_embed",
+        )(x)
+        h = h.reshape(B, -1, size.hidden)  # [B, n_patches, hidden]
+        cls = self.param(
+            "cls_token", nn.initializers.normal(0.02), (1, 1, size.hidden)
+        )
+        h = jnp.concatenate([jnp.broadcast_to(cls, (B, 1, size.hidden)), h], axis=1)
+        n_tokens = h.shape[1]
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, n_tokens, size.hidden)
+        )
+        h = h + pos
+        h = nn.Dropout(self.dropout_rate)(h, deterministic=not train)
+        block = TransformerBlock
+        if self.remat:
+            block = nn.remat(TransformerBlock, static_argnums=(3,))
+        for i in range(size.num_layers):
+            h = block(
+                size.hidden, size.heads, size.ff, self.dropout_rate,
+                self.attention_fn, name=f"layer_{i}",
+            )(h, None, not train)
+        h = nn.LayerNorm(epsilon=1e-6, name="ln_final")(h)
+        return nn.Dense(self.num_classes, name="head")(h[:, 0])
+
+
+ViTTiny = partial(ViT, size_name="tiny")
+ViTBase = partial(ViT, size_name="base", patch_size=16)
