@@ -1,0 +1,28 @@
+"""Driver entry-point regression: dryrun_multichip must keep compiling and
+executing the full parallelism menu as the framework evolves (run in a
+subprocess: it needs its own simulated-device topology)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_subprocess():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "PYTHONPATH": repo,
+    }
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "__graft_entry__.py"), "8"],
+        capture_output=True, text=True, timeout=900, env=env, cwd=repo,
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    assert "dryrun_multichip(8): OK" in out.stdout
+    for part in ("dp+fsdp+bf16", "tensor-parallel", "ring-attention", "pipeline"):
+        assert part in out.stdout, f"missing {part} sub-check\n{out.stdout}"
