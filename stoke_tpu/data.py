@@ -275,6 +275,16 @@ class StokeDataLoader:
 
             if "collate_fn" not in kwargs:
                 kwargs["collate_fn"] = _numpy_safe_torch_collate()
+            if kwargs.get("num_workers", 0) > 0 and (
+                "multiprocessing_context" not in kwargs
+            ):
+                # fork()ing a JAX process (multithreaded) can deadlock the
+                # workers; default to forkserver, the same fix the reference
+                # applies for horovod (stoke.py:809-820)
+                import multiprocessing
+
+                if "forkserver" in multiprocessing.get_all_start_methods():
+                    kwargs["multiprocessing_context"] = "forkserver"
             self._loader = torch_data.DataLoader(
                 dataset, batch_size=batch_size, **kwargs
             )
@@ -316,27 +326,31 @@ class StokeDataLoader:
             yield out
 
 
-def _numpy_safe_torch_collate():
+class _NumpySafeTorchCollate:
     """torch's default collate, post-converted to numpy so downstream device
     placement never touches torch dtypes XLA can't ingest (bf16 etc. stay on
-    the JAX side of the fence)."""
-    from torch.utils.data._utils.collate import default_collate
+    the JAX side of the fence).  A module-level class so multiprocessing
+    workers (forkserver/spawn) can pickle it."""
 
-    def _collate(samples):
+    @staticmethod
+    def _to_np(x):
+        if hasattr(x, "detach"):
+            return x.detach().cpu().numpy()
+        return x
+
+    def __call__(self, samples):
+        from torch.utils.data._utils.collate import default_collate
+
         batch = default_collate(samples)
-
-        def _to_np(x):
-            if hasattr(x, "detach"):
-                return x.detach().cpu().numpy()
-            return x
-
         if isinstance(batch, (tuple, list)):
-            return type(batch)(_to_np(b) for b in batch)
+            return type(batch)(self._to_np(b) for b in batch)
         if isinstance(batch, dict):
-            return {k: _to_np(v) for k, v in batch.items()}
-        return _to_np(batch)
+            return {k: self._to_np(v) for k, v in batch.items()}
+        return self._to_np(batch)
 
-    return _collate
+
+def _numpy_safe_torch_collate():
+    return _NumpySafeTorchCollate()
 
 
 # --------------------------------------------------------------------------- #
