@@ -1125,6 +1125,12 @@ class Stoke:
     def status(self) -> Dict[str, Any]:
         return self._status_obj.status
 
+    def print_status(self) -> None:
+        """Pretty-print the full run status (reference status repr,
+        status.py:629-654; printed automatically at init when verbose)."""
+        if self.is_rank_0:
+            unrolled_print(repr(self._status_obj).splitlines())
+
     @property
     def model_access(self):
         """The underlying model adapter (reference model_access property)."""
