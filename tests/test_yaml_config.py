@@ -106,6 +106,28 @@ def test_missing_optimizer_raises():
         )
 
 
+def test_variadic_partition_rule_from_yaml(tmp_path, devices):
+    """The string "..." in a YAML partition rule compiles to the variadic
+    spec (stage-stacked pipeline parameters from pure config)."""
+    import yaml
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from stoke_tpu.parallel.sharding import compile_partition_rules, sharding_tree
+
+    doc = yaml.safe_load(yaml.safe_dump(
+        {"rules": [["^stages/", ["stage", "..."]]]}
+    ))
+    rules = compile_partition_rules(tuple((r, tuple(s)) for r, s in doc["rules"]))
+    mesh = Mesh(np.asarray(jax.devices("cpu")[:4]), ("stage",))
+    tree = {"stages": {"w": np.zeros((4, 8, 8)), "b": np.zeros((4, 8))}}
+    sh = sharding_tree(tree, mesh, lambda s: P(), rules)
+    assert sh["stages"]["w"].spec == P("stage", None, None)
+    assert sh["stages"]["b"].spec == P("stage", None)
+
+
 def test_yaml_file_roundtrip(tmp_path):
     import yaml
 
