@@ -20,6 +20,7 @@ from stoke_tpu.configs import (
     LossReduction,
     MeshConfig,
     OffloadOptimizerConfig,
+    OffloadParamsConfig,
     OSSConfig,
     ParamNormalize,
     PartitionRulesConfig,
@@ -79,6 +80,7 @@ __all__ = [
     "SDDPConfig",
     "FSDPConfig",
     "OffloadOptimizerConfig",
+    "OffloadParamsConfig",
     "PartitionRulesConfig",
     "ActivationCheckpointingConfig",
     "CheckpointConfig",

@@ -380,9 +380,12 @@ class StepEngine:
         rules: Optional[ShardingRules],
         remat: Optional[ActivationCheckpointingConfig] = None,
         offload_optimizer: Optional[Any] = None,
+        offload_params: Optional[Any] = None,
+        loss_weights: Optional[Any] = None,
     ):
         self.adapter = adapter
         self.loss_fn = loss_fn
+        self.loss_weights = loss_weights
         self.optimizer = optimizer
         self.precision = precision
         self.precision_config = precision_config
@@ -391,6 +394,7 @@ class StepEngine:
         self.rules = rules
         self.remat = remat
         self.offload_optimizer = offload_optimizer
+        self.offload_params = offload_params
         self._accum_cache: Dict[Any, Callable] = {}
         self._fwd_cache: Dict[Any, Callable] = {}
         self._loss_cache: Dict[Any, Callable] = {}
@@ -399,6 +403,10 @@ class StepEngine:
         self._var_shardings = None
         self._grad_shardings = None
         self._opt_shardings = None
+        self._param_device_sh = None
+        self._opt_device_sh = None
+        self._params_offloaded = False
+        self._opt_offloaded = False
         self._repl = None
 
     # -------------------------- placement ----------------------------- #
@@ -421,39 +429,85 @@ class StepEngine:
         self._var_shardings = {"params": params_sh, **other_sh}
         self._grad_shardings = self.rules.grad_shardings(variables["params"])
         self._opt_shardings = self.rules.opt_shardings(opt_state_shapes)
+        self._param_device_sh = params_sh
+        self._opt_device_sh = self._opt_shardings
         if self.offload_optimizer is not None:
-            self._opt_shardings = self._offload_shardings(self._opt_shardings)
+            self._opt_shardings, self._opt_offloaded = self._offload_shardings(
+                self._opt_shardings, self.offload_optimizer, "optimizer-state"
+            )
+        if self.offload_params is not None:
+            # ZeRO-3 param offload (reference DeepspeedOffloadParamConfig,
+            # configs.py:346-372): each chip's fsdp parameter shard lives in
+            # host RAM between steps; the compiled steps copy it into HBM
+            # (see _vars_to_compute) and write the update back to host via
+            # out_shardings.  Non-param collections (BN stats etc.) stay on
+            # device — small and touched every micro-batch.
+            host_sh, self._params_offloaded = self._offload_shardings(
+                params_sh, self.offload_params, "parameter"
+            )
+            self._var_shardings = {**self._var_shardings, "params": host_sh}
         self._repl = self.rules.replicated()
         return jax.device_put(variables, self._var_shardings)
 
-    def _offload_shardings(self, opt_shardings):
-        """Re-target optimizer-state shardings to host memory
+    def _offload_shardings(self, shardings, cfg, what: str):
+        """Re-target a sharding tree to host memory
         (``memory_kind="pinned_host"``) — the ZeRO-offload equivalent
-        (reference DeepspeedOffloadOptimizerConfig, configs.py:309-343).
-        Falls back to device placement where the runtime lacks host memory
-        kinds (e.g. the CPU simulator) when the config allows."""
+        (reference DeepspeedOffloadOptimizerConfig configs.py:309-343,
+        DeepspeedOffloadParamConfig :346-372).  Returns ``(shardings,
+        engaged)``; falls back to device placement (engaged=False) where the
+        runtime cannot compile host-memory round-trips (e.g. the CPU
+        simulator) when the config allows."""
         import warnings
 
-        from jax.sharding import NamedSharding as _NS
+        from jax.sharding import NamedSharding as _NS, PartitionSpec as _P
 
         def _to_host(sh):
             return _NS(sh.mesh, sh.spec, memory_kind="pinned_host")
 
         try:
-            probe = jax.tree_util.tree_leaves(opt_shardings)[0]
-            # pin the probe array's creation off the default backend (it may
-            # be a different, even unreachable, accelerator)
+            probe = jax.tree_util.tree_leaves(shardings)[0]
+            # capability probe: COMPILE the pattern offload actually uses —
+            # host input → device copy → compute → host output.  (A bare
+            # device_put to pinned_host succeeds on runtimes that still
+            # cannot compile host-memory outputs, e.g. the CPU simulator's
+            # "Side-effect ops cannot be replicated".)  Replicated spec: we
+            # only ask "does this runtime support host memory round-trips?".
+            host_sh = _NS(probe.mesh, _P(), memory_kind="pinned_host")
+            dev_sh = _NS(probe.mesh, _P())
             with jax.default_device(probe.mesh.devices.flat[0]):
-                jax.device_put(jnp.zeros((1,), jnp.float32), _to_host(probe))
-            return jax.tree_util.tree_map(_to_host, opt_shardings)
-        except Exception:
-            if self.offload_optimizer.fallback_to_device:
-                warnings.warn(
-                    "Stoke -- optimizer-state host offload unsupported on "
-                    "this runtime; keeping state on device"
+                seed = jax.device_put(jnp.zeros((1,), jnp.float32), host_sh)
+                roundtrip = jax.jit(
+                    lambda a: jax.device_put(a, dev_sh) + 1.0,
+                    out_shardings=host_sh,
                 )
-                return opt_shardings
+                jax.block_until_ready(roundtrip(seed))
+            return jax.tree_util.tree_map(_to_host, shardings), True
+        except Exception:
+            if cfg.fallback_to_device:
+                warnings.warn(
+                    f"Stoke -- {what} host offload unsupported on "
+                    f"this runtime; keeping state on device"
+                )
+                return shardings, False
             raise
+
+    def _vars_to_compute(self, variables):
+        """Copy host-offloaded params into device memory inside a trace
+        (XLA compiles this into a streamable host→HBM transfer).  Identity
+        when param offload is off / fell back."""
+        if not self._params_offloaded:
+            return variables
+        return {
+            **variables,
+            "params": jax.device_put(variables["params"], self._param_device_sh),
+        }
+
+    def _opt_to_compute(self, opt_state):
+        """Same as :meth:`_vars_to_compute` for host-offloaded optimizer
+        state (the update math runs in HBM; out_shardings write back)."""
+        if not self._opt_offloaded:
+            return opt_state
+        return jax.device_put(opt_state, self._opt_device_sh)
 
     def init_grad_buffer(self, variables):
         """Zero accumulation buffer, sharded per the tier's grad rule
@@ -498,6 +552,7 @@ class StepEngine:
 
             @jax.jit
             def _fwd(variables, rng, margs, mkwargs):
+                variables = self._vars_to_compute(variables)
                 sub = jax.random.split(rng)[1]
                 out, _ = self._run_forward_train(variables, sub, margs, mkwargs)
                 return out
@@ -511,6 +566,7 @@ class StepEngine:
 
             @jax.jit
             def _efwd(variables, margs, mkwargs):
+                variables = self._vars_to_compute(variables)
                 cvars = {
                     "params": self.precision.cast_compute(variables["params"]),
                     **{k: v for k, v in variables.items() if k != "params"},
@@ -578,6 +634,11 @@ class StepEngine:
             return self.loss_fn(*largs, **lkwargs)
 
         def _step(variables, grad_buf, scaler_state, rng, margs, mkwargs, larr):
+            # host-offloaded params → HBM copy OUTSIDE the grad closure, so
+            # grad cotangents stay in device memory (a transfer inside the
+            # closure would transpose to a host-memory cotangent and bounce
+            # the gradients host→device for the buffer add)
+            variables = self._vars_to_compute(variables)
             new_rng, sub = jax.random.split(rng)
             scale = scaler_state["scale"] if scaled else jnp.float32(1.0)
 
@@ -589,10 +650,32 @@ class StepEngine:
                 out, updated = fwd(vars_in)
                 loss_result = _loss_from_out(out, larr)
                 leaves, inner_def = jax.tree_util.tree_flatten(loss_result)
-                total = sum(jnp.asarray(l, jnp.float32).sum() for l in leaves)
+                if self.loss_weights is not None:
+                    # weighted multi-loss: the objective is Σ wᵢ·lossᵢ.
+                    # Gradients are linear, so one backward of the weighted
+                    # sum ≡ the reference's per-loss backward passes with
+                    # weights (fp16.py:545-579, stoke.py:891-902); per-loss
+                    # overflow isolation is subsumed by the single scaler.
+                    try:
+                        weighted = jax.tree_util.tree_map(
+                            lambda w, l: jnp.float32(w)
+                            * jnp.asarray(l, jnp.float32).sum(),
+                            self.loss_weights,
+                            loss_result,
+                        )
+                    except ValueError as e:
+                        raise ValueError(
+                            "Stoke -- loss_weights structure must match the "
+                            "loss() return structure"
+                        ) from e
+                    total = sum(jax.tree_util.tree_leaves(weighted))
+                else:
+                    total = sum(
+                        jnp.asarray(l, jnp.float32).sum() for l in leaves
+                    )
                 # reference divides the training loss by grad_accum at loss()
                 # time (stoke.py:901-911); fp16 additionally scales for the
-                # dynamic scaler.
+                # dynamic scaler.  Reported per-loss values stay UNweighted.
                 objective = total * inv_scale_accum * scale
                 report = jax.tree_util.tree_unflatten(
                     inner_def, [l * inv_scale_accum for l in leaves]
@@ -673,6 +756,9 @@ class StepEngine:
 
         def _window(variables, opt_state, grad_buf, scaler_state, rng,
                     margs_s, mkwargs_s, larr_s):
+            # host-offloaded params → HBM ONCE, outside the scan (the accum
+            # core's own transfer is then a no-op on already-device params)
+            variables = self._vars_to_compute(variables)
             params = variables["params"]
             nonparam0 = {k: v for k, v in variables.items() if k != "params"}
 
@@ -727,6 +813,10 @@ class StepEngine:
         optimizer = self.optimizer
 
         def _apply(variables, opt_state, grad_buf, scaler_state):
+            # host-offloaded state → HBM for the (bandwidth-bound) update;
+            # out_shardings write new params / opt state back to host
+            variables = self._vars_to_compute(variables)
+            opt_state = self._opt_to_compute(opt_state)
             params = variables["params"]
             inv = 1.0 / scaler_state["scale"] if scaled else jnp.float32(1.0)
             grads = jax.tree_util.tree_map(lambda g: g * inv, grad_buf)
@@ -814,6 +904,9 @@ class StepEngine:
 
         def _fused(variables, opt_state, grad_buf, scaler_state, rng, margs,
                    mkwargs, larr):
+            # host-offloaded params → HBM ONCE for both accum and apply (the
+            # cores' own transfers become no-ops on already-device params)
+            variables = self._vars_to_compute(variables)
             report, updated, new_buf, new_rng = accum(
                 variables, grad_buf, scaler_state, rng, margs, mkwargs, larr
             )

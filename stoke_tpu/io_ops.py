@@ -33,6 +33,8 @@ import threading
 from typing import Any, Dict, Optional
 
 _ASYNC_SAVES: list = []  # in-flight background save threads
+_ASYNC_ERRORS: list = []  # exceptions raised by background saves (surfaced in wait_for_saves)
+_INFLIGHT_TAGS: set = set()  # tag dirs being written by async saves (prune must skip)
 
 import jax
 import numpy as np
@@ -152,6 +154,12 @@ def save_checkpoint(
     root = make_folder(path)
     tag = checkpoint_tag(name, backward_step)
     tag_dir = os.path.join(root, tag)
+    is_async = config.async_save and not _is_multiprocess()
+    if is_async:
+        # claim the tag BEFORE creating the dir: a concurrently finishing
+        # earlier async save's _prune_old must never classify this (still
+        # meta-less) dir as a stale leftover during the gather window
+        _INFLIGHT_TAGS.add(tag_dir)
     if jax.process_index() == 0:
         os.makedirs(tag_dir, exist_ok=True)
     _barrier()
@@ -178,7 +186,7 @@ def save_checkpoint(
             _prune_old(root, name, config.max_to_keep)
             unrolled_print(f"Saved checkpoint {tag_dir}")
 
-    if config.async_save and not _is_multiprocess():
+    if is_async:
         # Async save: the device→host gather happens HERE, synchronously —
         # the compiled steps donate (invalidate) state buffers, so a
         # background thread must never touch device arrays.  Only the slow
@@ -186,21 +194,24 @@ def save_checkpoint(
         # written last so a crash mid-save never leaves a loadable partial
         # tag (load requires meta.json).  Multi-process saves stay
         # synchronous (gather collectives must run on the main thread).
-        host_state = {k: _gather_to_host(v) for k, v in state.items()}
+        try:
+            host_state = {k: _gather_to_host(v) for k, v in state.items()}
+        except BaseException:
+            _INFLIGHT_TAGS.discard(tag_dir)  # claim released on gather failure
+            raise
 
         def _bg():
-            for key, tree in host_state.items():
-                leaves, _ = _flat_arrays(tree)
-                np.savez(
-                    os.path.join(tag_dir, f"{key}.npz"),
-                    **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)},
-                )
-            # async writes use the consolidated layout regardless of the
-            # configured format; record that so load() reads it back right
-            nonlocal_config_format = CheckpointFormat.consolidated
-            if jax.process_index() == 0:
+            try:
+                for key, tree in host_state.items():
+                    leaves, _ = _flat_arrays(tree)
+                    np.savez(
+                        os.path.join(tag_dir, f"{key}.npz"),
+                        **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)},
+                    )
+                # async writes use the consolidated layout regardless of the
+                # configured format; record that so load() reads it back right
                 meta = {
-                    "format": nonlocal_config_format.value,
+                    "format": CheckpointFormat.consolidated.value,
                     "counters": counters,
                     "status": status,
                     "name": name,
@@ -210,8 +221,22 @@ def save_checkpoint(
                 if extras:
                     with open(os.path.join(tag_dir, "extras.pkl"), "wb") as f:
                         pickle.dump(extras, f)
+                # meta.json is on disk: this tag is now a complete, loadable
+                # checkpoint — leave the in-flight set BEFORE pruning so it
+                # counts toward its own keep window
+                _INFLIGHT_TAGS.discard(tag_dir)
                 _prune_old(root, name, config.max_to_keep)
                 unrolled_print(f"Saved checkpoint {tag_dir} (async)")
+            except BaseException as e:  # surfaced by wait_for_saves()
+                # write-phase failure → remove the partial tag (it can never
+                # load without meta.json).  A failure AFTER meta.json exists
+                # (e.g. a transient error inside _prune_old) leaves the
+                # complete, loadable checkpoint in place.
+                if not os.path.exists(os.path.join(tag_dir, "meta.json")):
+                    shutil.rmtree(tag_dir, ignore_errors=True)
+                _ASYNC_ERRORS.append((tag_dir, e))
+            finally:
+                _INFLIGHT_TAGS.discard(tag_dir)
 
         t = threading.Thread(target=_bg, name=f"stoke-save-{tag}", daemon=False)
         _ASYNC_SAVES.append(t)
@@ -228,21 +253,48 @@ def save_checkpoint(
 
 def wait_for_saves() -> None:
     """Block until all in-flight async checkpoint saves complete (call
-    before exiting or before loading a just-saved checkpoint)."""
+    before exiting or before loading a just-saved checkpoint).
+
+    Raises the first background-save failure (disk full, serialization
+    error, ...) rather than silently dropping it — a checkpoint that was
+    never written must not look saved (ADVICE r1: io_ops medium)."""
     while _ASYNC_SAVES:
         _ASYNC_SAVES.pop().join()
+    if _ASYNC_ERRORS:
+        tag_dir, err = _ASYNC_ERRORS[0]
+        rest = len(_ASYNC_ERRORS) - 1
+        _ASYNC_ERRORS.clear()
+        raise RuntimeError(
+            f"Stoke -- async checkpoint save to {tag_dir} failed"
+            + (f" (+{rest} more)" if rest else "")
+        ) from err
 
 
 def _prune_old(root: str, name: str, max_to_keep: Optional[int]) -> None:
-    """Keep the newest N tags (by backward step) for this name."""
+    """Keep the newest N tags (by backward step) for this name.
+
+    Tags this process is still writing (``_INFLIGHT_TAGS``; async saves
+    write ``meta.json`` last) are never pruned — deleting one mid-write
+    would corrupt a concurrent save.  Meta-less tags that are NOT in flight
+    are leftovers from a crashed/failed save and are pruned like any other
+    old tag (they can never load)."""
     if not max_to_keep:
         return
-    tags = []
+    tags, stale = [], []
     for entry in os.listdir(root):
         m = _TAG_RE.match(entry)
         if m and m.group("name") == name:
+            if os.path.join(root, entry) in _INFLIGHT_TAGS:
+                continue
+            if not os.path.exists(os.path.join(root, entry, "meta.json")):
+                stale.append(entry)  # crashed/failed leftover, never loadable
+                continue
             tags.append((int(m.group("step")), entry))
     tags.sort()
+    # only loadable tags count toward the keep window (a crashed leftover
+    # must never displace a loadable checkpoint)
+    for entry in stale:
+        shutil.rmtree(os.path.join(root, entry), ignore_errors=True)
     for _, entry in tags[:-max_to_keep]:
         shutil.rmtree(os.path.join(root, entry), ignore_errors=True)
 

@@ -39,18 +39,6 @@ DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30
 
 
-def _validate(q, k, v, mask, heads):
-    if q.ndim != 3:
-        raise ValueError(f"expected [BH, L, D] inputs, got {q.shape}")
-    if q.shape != k.shape or k.shape != v.shape:
-        raise ValueError("q/k/v shapes must match")
-    if mask is not None and mask.shape != (q.shape[0] // heads, q.shape[1]):
-        raise ValueError(
-            f"mask must be [B, L] = {(q.shape[0] // heads, q.shape[1])}, "
-            f"got {mask.shape}"
-        )
-
-
 # --------------------------------------------------------------------------- #
 # forward
 # --------------------------------------------------------------------------- #
@@ -379,7 +367,15 @@ def flash_attention(
     ``interpret=None`` auto-selects the pallas interpreter off-TPU (tests).
     L must be divisible by the block sizes (block sizes are clamped to L).
     """
+    if q.ndim != 4:
+        raise ValueError(f"expected [B, H, L, D] inputs, got {q.shape}")
+    if q.shape != k.shape or k.shape != v.shape:
+        raise ValueError(
+            f"q/k/v shapes must match, got {q.shape}/{k.shape}/{v.shape}"
+        )
     B, H, L, D = q.shape
+    if mask is not None and mask.shape != (B, L):
+        raise ValueError(f"mask must be [B, L] = {(B, L)}, got {mask.shape}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     block_q, block_k = min(block_q, L), min(block_k, L)

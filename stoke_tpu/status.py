@@ -35,6 +35,7 @@ from stoke_tpu.configs import (
     FSDPConfig,
     MeshConfig,
     OffloadOptimizerConfig,
+    OffloadParamsConfig,
     OSSConfig,
     PartitionRulesConfig,
     PrecisionConfig,
@@ -182,9 +183,119 @@ class StokeStatus:
     # The legal-combination matrix (reference status.py:192-289)
     # ------------------------------------------------------------------ #
 
-    def _rules(self) -> List[Tuple[Callable[[Dict[str, Any]], bool], str]]:
-        """Table of (predicate, message).  A predicate returning True means the
-        combination is ILLEGAL.  Table-driven so tests enumerate it."""
+    def _mesh_axes(self) -> Tuple[str, ...]:
+        """Axis names of the mesh this run would build (build_mesh uses
+        MeshConfig.axes verbatim; default 1-D ("data",))."""
+        mc = self._configs.get("MeshConfig")
+        return tuple(mc.axes) if mc is not None else ("data",)
+
+    def _rules(self) -> List[Tuple[Callable[[Dict[str, Any]], Any], str]]:
+        """Table of (predicate, message).  A predicate returning truthy means
+        the combination is ILLEGAL; returning a string overrides the static
+        message (for named-axis diagnostics).  Table-driven so tests
+        enumerate it (reference ``_check_all_raised_combinations``,
+        status.py:192-289)."""
+
+        def _ignored_without_distributed(cfg_name):
+            def rule(s):
+                return cfg_name in self._configs and s["distributed"] is None
+            return rule
+
+        def _mesh_shape_mismatch(s):
+            mc = self._configs.get("MeshConfig")
+            if mc is None:
+                return False
+            if len(set(mc.axes)) != len(mc.axes):
+                return f"MeshConfig has duplicate axis names {mc.axes}"
+            if mc.shape is not None and len(mc.shape) != len(mc.axes):
+                return (
+                    f"MeshConfig shape {mc.shape} has {len(mc.shape)} entries "
+                    f"but axes {mc.axes} has {len(mc.axes)}"
+                )
+            return False
+
+        def _partition_rule_axis_unknown(s):
+            prc = self._configs.get("PartitionRulesConfig")
+            if prc is None or s["distributed"] is None:
+                return False
+            axes = set(self._mesh_axes())
+            for rx, spec in prc.rules:
+                for entry in spec:
+                    # multi-axis dims may arrive as tuples or (from YAML) lists
+                    names = (
+                        tuple(entry)
+                        if isinstance(entry, (tuple, list))
+                        else (entry,)
+                    )
+                    for n in names:
+                        if isinstance(n, str) and n != "..." and n not in axes:
+                            return (
+                                f"partition rule {rx!r} names mesh axis "
+                                f"{n!r} but the mesh only has axes "
+                                f"{sorted(axes)} — add it to MeshConfig.axes "
+                                f"or fix the rule"
+                            )
+            return False
+
+        def _seq_axis_missing(s):
+            dp = self._configs.get("DataParallelConfig")
+            if dp is None or dp.shard_seq_dim is None:
+                return False
+            if s["distributed"] is None:
+                return (
+                    "DataParallelConfig.shard_seq_dim is set but "
+                    "distributed=None; it would be silently ignored"
+                )
+            if dp.seq_axis_name not in self._mesh_axes():
+                return (
+                    f"DataParallelConfig.shard_seq_dim is set but the mesh "
+                    f"has no {dp.seq_axis_name!r} axis (axes: "
+                    f"{list(self._mesh_axes())}) — add it to MeshConfig.axes"
+                )
+            return False
+
+        def _tier_axis_missing(s):
+            if not (s["oss"] or s["sddp"] or s["fsdp"]):
+                return False
+            dp = self._configs.get("DataParallelConfig")
+            axis = dp.axis_name if dp is not None else "data"
+            if axis not in self._mesh_axes():
+                tier = "fsdp" if s["fsdp"] else ("sddp" if s["sddp"] else "oss")
+                return (
+                    f"{tier} shards state over mesh axis {axis!r} but the "
+                    f"mesh only has axes {list(self._mesh_axes())} — the "
+                    f"tier would silently do nothing"
+                )
+            return False
+
+        def _tensorboard_unimportable(s):
+            if "TensorboardConfig" not in self._configs:
+                return False
+            try:
+                import torch.utils.tensorboard  # noqa: F401
+
+                return False
+            except Exception:
+                return True
+
+        def _offload_cpu_no_fallback(s):
+            for name in ("OffloadOptimizerConfig", "OffloadParamsConfig"):
+                cfg = self._configs.get(name)
+                if (
+                    cfg is not None
+                    and not cfg.fallback_to_device
+                    and s["device"] is DeviceOptions.cpu
+                ):
+                    return (
+                        f"{name}(fallback_to_device=False) on device='cpu': "
+                        f"the CPU runtime has no pinned_host memory kind; "
+                        f"allow fallback or use device='tpu'"
+                    )
+            return False
+
+        def _param_offload_requires_fsdp(s):
+            return "OffloadParamsConfig" in self._configs and not s["fsdp"]
+
         return [
             (
                 lambda s: s["batch_size_per_device"] is None
@@ -222,12 +333,61 @@ class StokeStatus:
                 "sharding tiers (oss/sddp/fsdp) require distributed='dp' — "
                 "reference status.py:231-263",
             ),
+            # --- configs supplied but structurally ignored (fail loud at
+            # init instead of silently doing nothing / erroring at compile) ---
+            (
+                _ignored_without_distributed("MeshConfig"),
+                "MeshConfig supplied but distributed=None; the mesh would be "
+                "silently ignored — set distributed='dp' or drop the config",
+            ),
+            (
+                _ignored_without_distributed("PartitionRulesConfig"),
+                "PartitionRulesConfig supplied but distributed=None; the "
+                "rules would be silently ignored — set distributed='dp' or "
+                "drop the config",
+            ),
+            # --- mesh-axis consistency (a bad axis otherwise surfaces as a
+            # cryptic GSPMD error at compile time) ---
+            (
+                _mesh_shape_mismatch,
+                "MeshConfig axes/shape inconsistent",
+            ),
+            (
+                _partition_rule_axis_unknown,
+                "partition rule names an unknown mesh axis",
+            ),
+            (
+                _seq_axis_missing,
+                "sequence-dim sharding configured without a seq mesh axis",
+            ),
+            (
+                _tier_axis_missing,
+                "sharding tier's data axis missing from the mesh",
+            ),
+            # --- dependency checks ---
+            (
+                _tensorboard_unimportable,
+                "TensorboardConfig requires torch (torch.utils.tensorboard) "
+                "which is not importable in this environment",
+            ),
+            (
+                _offload_cpu_no_fallback,
+                "offload config with fallback_to_device=False on device='cpu'",
+            ),
+            (
+                _param_offload_requires_fsdp,
+                "OffloadParamsConfig requires fsdp=True — parameter offload "
+                "is a ZeRO-3 feature (reference DeepspeedOffloadParamConfig "
+                "legal only at stage 3, configs.py:346-372)",
+            ),
         ]
 
     def _check_all_raised_combinations(self) -> None:
         for predicate, message in self._rules():
-            if predicate(self._status):
-                raise StokeValidationError(f"Stoke -- illegal combination: {message}")
+            result = predicate(self._status)
+            if result:
+                msg = result if isinstance(result, str) else message
+                raise StokeValidationError(f"Stoke -- illegal combination: {msg}")
 
     # ------------------------------------------------------------------ #
     # Post-init values (reference status.py:345-372, effective batch :373-375)
@@ -375,6 +535,12 @@ class StokeStatus:
         """None unless explicitly supplied (offload is opt-in, reference
         configs.py:309-343)."""
         return self._configs.get("OffloadOptimizerConfig")
+
+    @property
+    def offload_params_config(self):
+        """None unless explicitly supplied (param offload is opt-in and
+        fsdp-only, reference configs.py:346-372)."""
+        return self._configs.get("OffloadParamsConfig")
 
     @property
     def activation_checkpointing_config(self) -> Optional[ActivationCheckpointingConfig]:

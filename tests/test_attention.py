@@ -161,6 +161,20 @@ def test_flash_rejects_indivisible_length(rng, devices):
         flash_attention(q, q, q, block_q=32, block_k=32)
 
 
+def test_flash_rejects_bad_shapes(rng, devices):
+    """Shape errors surface as named ValueErrors, not opaque pallas BlockSpec
+    failures (ADVICE r1)."""
+    from stoke_tpu.ops import flash_attention
+
+    q = jnp.zeros((1, 2, 32, 8))
+    with pytest.raises(ValueError, match=r"\[B, H, L, D\]"):
+        flash_attention(q[0], q[0], q[0])  # 3D input
+    with pytest.raises(ValueError, match="must match"):
+        flash_attention(q, jnp.zeros((1, 2, 32, 16)), q)
+    with pytest.raises(ValueError, match=r"mask must be \[B, L\]"):
+        flash_attention(q, q, q, jnp.ones((2, 32), jnp.int32))
+
+
 def test_flash_as_model_attention_fn(rng, devices):
     """make_flash_attention plugs into the BERT encoder."""
     from stoke_tpu import init_module

@@ -109,6 +109,63 @@ def test_tier_placements(devices):
     assert s.params["w1"].sharding.spec != P()
 
 
+def test_param_offload_fsdp_trains(devices):
+    """fsdp + OffloadParamsConfig: params live in host memory between steps
+    (ZeRO-3 offload, reference DeepspeedOffloadParamConfig) — or fall back
+    with a warning on runtimes without host memory kinds — and numerics
+    still match plain DP."""
+    import warnings
+
+    from stoke_tpu import OffloadParamsConfig
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s = make(
+            distributed="dp",
+            fsdp=True,
+            configs=[FSDPConfig(min_weight_size=1), OffloadParamsConfig()],
+        )
+    kinds = {
+        getattr(p.sharding, "memory_kind", None)
+        for p in jax.tree_util.tree_leaves(s.params)
+    }
+    offloaded = kinds == {"pinned_host"}
+    loss_dp, w_dp = run_steps(make(distributed="dp"))
+    loss_o, w_o = run_steps(s)
+    assert loss_o == pytest.approx(loss_dp, rel=1e-4)
+    np.testing.assert_allclose(w_o, w_dp, rtol=1e-4, atol=1e-6)
+    if offloaded:
+        # params written back to host memory by the compiled steps
+        kinds_after = {
+            p.sharding.memory_kind for p in jax.tree_util.tree_leaves(s.params)
+        }
+        assert kinds_after == {"pinned_host"}
+
+
+def test_param_offload_requires_fsdp():
+    from stoke_tpu import OffloadParamsConfig, StokeValidationError
+
+    with pytest.raises(StokeValidationError, match="fsdp"):
+        make(distributed="dp", configs=[OffloadParamsConfig()])
+
+
+def test_multiprocess_batch_divisibility(devices, monkeypatch):
+    """Multi-process: the LOCAL batch must divide the process's local shard
+    count of the data axis (not the GLOBAL axis size), indivisible raises,
+    batch-dim-less leaves replicate."""
+    s = make(distributed="dp")  # 8-device data mesh, single process
+    monkeypatch.setattr(jax, "process_count", lambda: 2)  # 2 procs × 4 shards
+    assert s._batch_sharding_for((4, IN)).spec == P("data")  # 4 % 4 == 0
+    assert s._batch_sharding_for((8, IN)).spec == P("data")
+    with pytest.raises(ValueError, match="per-process"):
+        s._batch_sharding_for((6, IN))  # 6 % 4 != 0 → error, not replication
+    assert s._batch_sharding_for(()).spec == P()  # scalar leaf replicates
+    # data axis must split evenly across processes
+    monkeypatch.setattr(jax, "process_count", lambda: 3)
+    with pytest.raises(ValueError, match="divide evenly"):
+        s._batch_sharding_for((8, IN))
+
+
 def test_batch_lands_sharded(devices):
     s = make(distributed="dp")
     x = np.zeros((32, IN), np.float32)

@@ -181,6 +181,43 @@ def test_deferred_path_extraction(rng):
     np.testing.assert_allclose(np.asarray(out[1]), 2 * np.asarray(out[0]), rtol=1e-5)
 
 
+def test_deferred_value_rng_stable_after_loss(rng):
+    """.value must reproduce the dropout masks the fused step used, even when
+    read AFTER loss() has advanced the live rng (rng stashed at model() time,
+    ADVICE r1)."""
+    import flax.linen as nn
+
+    class Drop(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            h = nn.Dense(8)(x)
+            return nn.Dropout(0.5, deterministic=not train)(h)
+
+    model = Drop()
+    x = rng.normal(size=(8, 4)).astype(np.float32)
+    v = model.init(jax.random.PRNGKey(0), x, train=False)
+    s = Stoke(
+        model=model,
+        optimizer=StokeOptimizer(
+            optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.1}
+        ),
+        loss=mse,
+        params=v,
+        batch_size_per_device=8,
+        model_train_kwargs={"train": True},
+        model_eval_kwargs={"train": False},
+        verbose=False,
+    )
+    y = np.zeros((8, 8), np.float32)
+    out = s.model(x)
+    before = np.asarray(out.value)
+    l = float(s.loss(out, y))  # fused step consumes + advances the rng
+    after = np.asarray(out.value)
+    np.testing.assert_array_equal(before, after)
+    # and the fused step saw those SAME masks: loss(value, y) == reported loss
+    assert l == pytest.approx(float(np.mean((before - y) ** 2)), rel=1e-5)
+
+
 def test_stale_deferred_rejected(rng):
     s = make_stoke()
     x, y = batch(rng)
@@ -232,6 +269,68 @@ def test_multi_loss_dict(rng):
     s.step()
     assert s.optimizer_steps == 1
     assert s.step_loss == pytest.approx(float(l["mse"]) + float(l["reg"]), rel=1e-5)
+
+
+def test_loss_weights_match_hand_weighted_objective(rng):
+    """loss_weights: grads of Σ wᵢ·lossᵢ (the reference's per-loss backward
+    with weights, fp16.py:545-579), reports stay unweighted."""
+
+    def two_losses(out, y):
+        return (jnp.mean((out - y) ** 2), jnp.mean(out**2))
+
+    w1, w2 = 0.7, 0.25
+    s = make_stoke(loss=two_losses, loss_weights=(w1, w2))
+    x, y = batch(rng)
+    l = s.loss(s.model(x), y)
+    s.backward(l)
+    s.step()
+    # reported values are the UNweighted per-loss values
+    manual_out = np.zeros_like(y)  # zero-init params → out == 0
+    assert float(l[0]) == pytest.approx(float(np.mean((manual_out - y) ** 2)), rel=1e-5)
+
+    # equivalent hand-weighted single loss must give identical params
+    def weighted(out, y):
+        return w1 * jnp.mean((out - y) ** 2) + w2 * jnp.mean(out**2)
+
+    s2 = make_stoke(loss=weighted)
+    s2.backward(s2.loss(s2.model(x), y))
+    s2.step()
+    np.testing.assert_allclose(
+        np.asarray(s.params["w"]), np.asarray(s2.params["w"]), rtol=1e-6
+    )
+
+
+def test_loss_weights_dict(rng):
+    """Dict losses with dict weights."""
+
+    def dict_loss(out, y):
+        return {"mse": jnp.mean((out - y) ** 2), "reg": jnp.mean(out**2)}
+
+    s = make_stoke(loss=dict_loss, loss_weights={"mse": 1.0, "reg": 0.5})
+    x, y = batch(rng)
+    s.backward(s.loss(s.model(x), y))
+    s.step()
+    assert s.optimizer_steps == 1
+
+    def weighted(out, y):
+        return jnp.mean((out - y) ** 2) + 0.5 * jnp.mean(out**2)
+
+    s2 = make_stoke(loss=weighted)
+    s2.backward(s2.loss(s2.model(x), y))
+    s2.step()
+    np.testing.assert_allclose(
+        np.asarray(s.params["w"]), np.asarray(s2.params["w"]), rtol=1e-6
+    )
+
+
+def test_loss_weights_structure_mismatch_raises(rng):
+    def two_losses(out, y):
+        return (jnp.mean((out - y) ** 2), jnp.mean(out**2))
+
+    s = make_stoke(loss=two_losses, loss_weights=(1.0,))  # wrong arity
+    x, y = batch(rng)
+    with pytest.raises(ValueError, match="loss_weights"):
+        s.loss(s.model(x), y)
 
 
 def test_deferred_dict_output_key_access(rng):

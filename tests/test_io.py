@@ -232,6 +232,87 @@ def test_async_save_roundtrip(tmp_path):
     np.testing.assert_allclose(np.asarray(s2.params["w1"]), w_at_save, rtol=1e-6)
 
 
+def test_async_save_failure_surfaces(tmp_path, monkeypatch):
+    """A background save that dies (disk full, ...) must raise in
+    wait_for_checkpoint(), not vanish (ADVICE r1 medium)."""
+    from stoke_tpu import io_ops
+
+    s = train_a_bit(make(configs=[CheckpointConfig(async_save=True)]), steps=1)
+
+    def boom(*a, **k):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(io_ops.np, "savez", boom)
+    s.save(str(tmp_path / "ckpt"))
+    with pytest.raises(RuntimeError, match="async checkpoint save"):
+        s.wait_for_checkpoint()
+    # error queue drained: a later wait is clean
+    s.wait_for_checkpoint()
+
+
+def test_prune_skips_inflight_cleans_stale(tmp_path):
+    """_prune_old never touches an in-flight async tag, deletes crashed
+    meta-less leftovers, and never lets a leftover displace a loadable
+    checkpoint from the keep window."""
+    from stoke_tpu import io_ops
+    from stoke_tpu.io_ops import _INFLIGHT_TAGS, _prune_old, checkpoint_tag
+    import os
+
+    root = str(tmp_path)
+    for step in (1, 2, 3, 5):
+        d = os.path.join(root, checkpoint_tag("run", step))
+        os.makedirs(d)
+        if step not in (2, 5):  # 2 = in-flight, 5 = crashed leftover
+            with open(os.path.join(d, "meta.json"), "w") as f:
+                f.write("{}")
+    inflight = os.path.join(root, checkpoint_tag("run", 2))
+    _INFLIGHT_TAGS.add(inflight)
+    try:
+        _prune_old(root, "run", max_to_keep=1)
+    finally:
+        _INFLIGHT_TAGS.discard(inflight)
+    remaining = sorted(os.listdir(root))
+    assert checkpoint_tag("run", 2) in remaining  # in-flight survives
+    assert checkpoint_tag("run", 3) in remaining  # newest LOADABLE survives
+    assert checkpoint_tag("run", 1) not in remaining  # old loadable pruned
+    assert checkpoint_tag("run", 5) not in remaining  # crashed leftover cleaned
+
+
+def test_async_save_respects_max_to_keep(tmp_path):
+    """A finished async save counts toward its own keep window: disk never
+    holds max_to_keep+1 checkpoints after the threads drain."""
+    import os
+
+    s = train_a_bit(
+        make(configs=[CheckpointConfig(async_save=True, max_to_keep=1)]), steps=1
+    )
+    path = str(tmp_path / "ckpt")
+    s.save(path)
+    s = train_a_bit(s, steps=1)
+    s.save(path)
+    s.wait_for_checkpoint()
+    tags = [e for e in os.listdir(path) if e.startswith("stoke-")]
+    assert tags == ["stoke-stoke-model-backward-step-2"] or len(tags) == 1
+
+
+def test_failed_async_save_removes_partial_tag(tmp_path, monkeypatch):
+    """A failed async save removes its partial tag directory (no disk leak,
+    nothing unloadable left behind)."""
+    import os
+
+    from stoke_tpu import io_ops
+
+    s = train_a_bit(make(configs=[CheckpointConfig(async_save=True)]), steps=1)
+    monkeypatch.setattr(
+        io_ops.np, "savez", lambda *a, **k: (_ for _ in ()).throw(OSError("disk full"))
+    )
+    path = str(tmp_path / "ckpt")
+    tag_dir = s.save(path)
+    with pytest.raises(RuntimeError):
+        s.wait_for_checkpoint()
+    assert not os.path.exists(tag_dir)
+
+
 def test_structure_mismatch_rejected(tmp_path):
     s = train_a_bit(make())
     path = str(tmp_path / "ckpt")

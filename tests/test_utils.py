@@ -96,6 +96,12 @@ def test_loss_reduction_sum(rng):
     y = np.zeros((32, 2), np.float32)
     l = s.loss(s.model(x), y)
     assert s.detach_and_sync_loss(l) == pytest.approx(float(l) * 8, rel=1e-5)
+    # a sum-reduced user loss is already a global sum: no extra scaling
+    assert s.detach_and_sync_loss(l, user_reduction="sum") == pytest.approx(
+        float(l), rel=1e-5
+    )
+    with pytest.raises(ValueError):
+        s.detach_and_sync_loss(l, user_reduction="nope")
 
 
 def test_force_cpu_contract():
