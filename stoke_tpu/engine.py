@@ -48,7 +48,7 @@ from stoke_tpu.configs import (
     PrecisionOptions,
     StokeOptimizer,
 )
-from stoke_tpu.parallel.sharding import ShardingRules
+from stoke_tpu.parallel.sharding import ShardingRules, place_global_tree
 from stoke_tpu.utils.trees import tree_cast, tree_finite, tree_zeros_like
 
 
@@ -431,6 +431,9 @@ class StepEngine:
         self._opt_shardings = self.rules.opt_shardings(opt_state_shapes)
         self._param_device_sh = params_sh
         self._opt_device_sh = self._opt_shardings
+        # device-memory layout of the variables (== _var_shardings unless
+        # param offload retargets the latter to pinned_host)
+        self._var_device_shardings = self._var_shardings
         if self.offload_optimizer is not None:
             self._opt_shardings, self._opt_offloaded = self._offload_shardings(
                 self._opt_shardings, self.offload_optimizer, "optimizer-state"
@@ -447,7 +450,7 @@ class StepEngine:
             )
             self._var_shardings = {**self._var_shardings, "params": host_sh}
         self._repl = self.rules.replicated()
-        return jax.device_put(variables, self._var_shardings)
+        return place_global_tree(variables, self._var_shardings)
 
     def _offload_shardings(self, shardings, cfg, what: str):
         """Re-target a sharding tree to host memory
@@ -475,7 +478,9 @@ class StepEngine:
             host_sh = _NS(probe.mesh, _P(), memory_kind="pinned_host")
             dev_sh = _NS(probe.mesh, _P())
             with jax.default_device(probe.mesh.devices.flat[0]):
-                seed = jax.device_put(jnp.zeros((1,), jnp.float32), host_sh)
+                seed = place_global_tree(
+                    np.zeros((1,), np.float32), host_sh
+                )
                 roundtrip = jax.jit(
                     lambda a: jax.device_put(a, dev_sh) + 1.0,
                     out_shardings=host_sh,
@@ -515,7 +520,7 @@ class StepEngine:
         extensions.py:219-286)."""
         zeros = tree_zeros_like(variables["params"])
         if self._grad_shardings is not None:
-            zeros = jax.device_put(zeros, self._grad_shardings)
+            zeros = place_global_tree(zeros, self._grad_shardings)
         return zeros
 
     def init_opt_state(self, variables):
@@ -925,7 +930,11 @@ class StepEngine:
             out_sh = (
                 None,  # report
                 None,  # updated collections
-                self._var_shardings,
+                # non-boundary micro-steps leave params in device memory:
+                # writing the UNCHANGED params back to pinned_host (and in
+                # again next micro-step) would be a pure host<->HBM round
+                # trip; only the boundary step persists to the offload tier
+                self._var_shardings if do_apply else self._var_device_shardings,
                 self._opt_shardings,
                 self._grad_shardings,
                 {"scale": repl, "growth_count": repl},

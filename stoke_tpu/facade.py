@@ -50,7 +50,7 @@ from stoke_tpu.engine import (
     is_deferred,
 )
 from stoke_tpu.parallel.mesh import build_mesh, initialize_distributed
-from stoke_tpu.parallel.sharding import make_sharding_rules
+from stoke_tpu.parallel.sharding import make_sharding_rules, place_global_tree
 from stoke_tpu.status import StokeStatus
 from stoke_tpu.utils.printing import unrolled_print
 from stoke_tpu.utils.trees import tree_count_params
@@ -235,8 +235,10 @@ class Stoke:
         )
         # create the key host-side: PRNGKey dispatches on the DEFAULT
         # backend, which may be a (possibly unreachable) accelerator even
-        # when this run targets cpu
-        with jax.default_device(jax.devices("cpu")[0]):
+        # when this run targets cpu.  LOCAL device: in multi-process runs
+        # jax.devices() lists other processes' (non-addressable) devices
+        # first.
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
             key = jax.random.PRNGKey(seed)
         self._rng = self._place_scalar_tree(key)
 
@@ -307,7 +309,7 @@ class Stoke:
     def _place_scalar_tree(self, tree):
         if self._rules is not None:
             repl = self._rules.replicated()
-            return jax.tree_util.tree_map(lambda x: jax.device_put(x, repl), tree)
+            return place_global_tree(tree, repl)
         return jax.device_put(tree, self._device)
 
     def _batch_sharding_for(self, shape, batch_dim: int = 0):

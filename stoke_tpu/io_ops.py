@@ -96,10 +96,14 @@ def _load_consolidated(tag_dir: str, key: str, like: Any) -> Any:
                 f"{len(leaves_like)} (model/optimizer structure changed?)"
             )
         loaded = [data[f"leaf_{i}"] for i in range(n)]
+    from stoke_tpu.parallel.sharding import place_global_tree
+
     placed = []
     for arr, ref in zip(loaded, leaves_like):
         if hasattr(ref, "sharding"):
-            placed.append(jax.device_put(arr.astype(ref.dtype), ref.sharding))
+            placed.append(
+                place_global_tree(arr.astype(ref.dtype), ref.sharding)
+            )
         else:
             placed.append(arr)
     return jax.tree_util.tree_unflatten(treedef, placed)
@@ -158,11 +162,17 @@ def save_checkpoint(
     if is_async:
         # claim the tag BEFORE creating the dir: a concurrently finishing
         # earlier async save's _prune_old must never classify this (still
-        # meta-less) dir as a stale leftover during the gather window
+        # meta-less) dir as a stale leftover during the gather window.
+        # Released on ANY failure before the background thread takes over
+        # (the thread then owns the release).
         _INFLIGHT_TAGS.add(tag_dir)
-    if jax.process_index() == 0:
-        os.makedirs(tag_dir, exist_ok=True)
-    _barrier()
+    try:
+        if jax.process_index() == 0:
+            os.makedirs(tag_dir, exist_ok=True)
+        _barrier()
+    except BaseException:
+        _INFLIGHT_TAGS.discard(tag_dir)
+        raise
     state = {
         "variables": variables,
         "opt_state": opt_state,
@@ -240,7 +250,12 @@ def save_checkpoint(
 
         t = threading.Thread(target=_bg, name=f"stoke-save-{tag}", daemon=False)
         _ASYNC_SAVES.append(t)
-        t.start()
+        try:
+            t.start()
+        except BaseException:
+            _ASYNC_SAVES.remove(t)
+            _INFLIGHT_TAGS.discard(tag_dir)
+            raise
         return tag_dir
     if config.format is CheckpointFormat.consolidated:
         _save_consolidated(tag_dir, state)

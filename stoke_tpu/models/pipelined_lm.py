@@ -63,7 +63,11 @@ class PipelinedLM(ModelAdapter):
         vocab_size / size_name / max_len: as in :class:`~stoke_tpu.models.GPT`.
         num_microbatches: microbatches the input batch is split into (batch
             must be divisible); more microbatches = less pipeline bubble.
-        layers_per_stage: blocks per stage (total layers = S × this).
+        layers_per_stage: blocks per stage (total layers = rounds × S × this).
+        rounds: virtual stages per device (circular/interleaved schedule;
+            bubble shrinks from (S-1)/(M+S-1) to (S-1)/(rounds·M+S-1)).
+        remat: rematerialize each per-tick stage application (1F1B-style
+            activation memory).
 
     Usage:
         adapter = PipelinedLM(mesh, vocab_size=..., num_microbatches=4)
@@ -83,6 +87,8 @@ class PipelinedLM(ModelAdapter):
         num_microbatches: int = 2,
         layers_per_stage: Optional[int] = None,
         stage_axis: str = "stage",
+        rounds: int = 1,
+        remat: bool = False,
     ):
         self.mesh = mesh
         self.vocab_size = vocab_size
@@ -90,14 +96,15 @@ class PipelinedLM(ModelAdapter):
         self.max_len = max_len
         self.num_microbatches = num_microbatches
         self.stage_axis = stage_axis
-        self.num_stages = mesh.shape[stage_axis]
+        self.rounds = int(rounds)
+        self.num_stages = mesh.shape[stage_axis] * self.rounds
         if layers_per_stage is None:
             layers_per_stage = max(1, self.size.num_layers // self.num_stages)
         self.layers_per_stage = layers_per_stage
         self._stage_module = _StageBlock(self.size, layers_per_stage)
         self._piped = pipeline(
             lambda p, x: self._stage_module.apply({"params": p}, x),
-            mesh, stage_axis,
+            mesh, stage_axis, rounds=self.rounds, remat=remat,
         )
 
     # ------------------------------------------------------------------ #

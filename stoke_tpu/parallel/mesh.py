@@ -78,6 +78,15 @@ def initialize_distributed(cfg: DistributedInitConfig) -> bool:
     global _DIST_INITIALIZED
     if _DIST_INITIALIZED:
         return True
+    # the launcher may have called jax.distributed.initialize itself (e.g.
+    # a multi-process test harness must rendezvous before ANY backend use);
+    # record and respect it rather than re-initializing
+    try:
+        if jax.distributed.is_initialized():
+            _DIST_INITIALIZED = True
+            return True
+    except AttributeError:
+        pass
     explicit = cfg.num_processes is not None or cfg.coordinator_address is not None
     if not explicit and not _multihost_env_present():
         return False

@@ -1,16 +1,36 @@
-"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh axis.
+"""Pipeline parallelism: microbatch pipelining over a mesh axis.
 
 Capability upside beyond the reference (SURVEY.md §2.8: "no pipeline
-parallelism").  The pattern: identical stages live on consecutive devices of
-a ``stage`` mesh axis (stage s holds slice s of the stacked stage
-parameters); microbatches stream through — each tick every stage processes
-the activation it holds and ``ppermute``s the result to its neighbor (ICI
-link), so after a fill phase of S-1 ticks all stages compute concurrently.
+parallelism").  The pattern: stages live on consecutive devices of a
+``stage`` mesh axis (device d holds slice(s) of the stacked stage
+parameters); microbatches stream through — each tick every device processes
+the activation it holds and ``ppermute``s the result to its neighbor (an ICI
+link), so after a fill phase of S-1 ticks all devices compute concurrently.
+
+Two schedules, one implementation (``rounds``):
+
+- ``rounds=1`` — classic GPipe fill-drain: L = S stages, bubble fraction
+  (S-1)/(M+S-1) for M microbatches.
+- ``rounds=V>1`` — circular/interleaved schedule: L = V·S stages, device d
+  holds stages {d, d+S, ..., d+(V-1)S}; each microbatch laps the ring V
+  times (a returning activation waits in a device-local slot buffer until
+  its round's stream position comes up).  Same total compute, bubble
+  fraction (S-1)/(V·M+S-1) — the interleaving the fill-drain schedule
+  can't reach (Megatron-LM interleaved / praxis circular equivalent).
 
 Differentiation is automatic: the transpose of ``ppermute`` is the reverse
 rotation, so ``jax.grad`` of the pipelined function IS backward pipelining
-(outputs of fill/drain garbage ticks are masked out, so their gradient
-contribution is exactly zero).
+(fill/drain garbage ticks are masked, so their gradient contribution is
+exactly zero).  ``remat=True`` wraps each per-tick stage application in
+``jax.checkpoint``: saved residuals shrink to the wire activations — the
+activation-memory profile 1F1B exists for, without a hand-written backward
+schedule (the backward pass still pipelines tick-by-tick through the
+transposed rotation).
+
+The wire (inter-stage activation) may be any pytree, but its
+structure/shapes must be uniform across stages — that is fundamental to a
+rotating SPMD schedule.  Non-uniform INPUT/OUTPUT edges (embedding in, LM
+head out) compose OUTSIDE the rotation via :func:`pipeline_with_edges`.
 
 This is the composable building block (function-level, mesh in hand); full
 facade integration (stage-stacked optimizers etc.) composes via
@@ -19,7 +39,7 @@ facade integration (stage-stacked optimizers etc.) composes via
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -29,77 +49,203 @@ from jax.sharding import Mesh, PartitionSpec as P
 from stoke_tpu.ops.attention import shard_map
 
 
+def _tree_where(pred, a, b):
+    return jax.tree_util.tree_map(
+        lambda x, y: jnp.where(pred, x, y), a, b
+    )
+
+
+def _tree_index(tree, i):
+    return jax.tree_util.tree_map(
+        lambda a: lax.dynamic_index_in_dim(a, i, axis=0, keepdims=False), tree
+    )
+
+
+def _tree_update(tree, update, i):
+    return jax.tree_util.tree_map(
+        lambda buf, u: lax.dynamic_update_slice_in_dim(
+            buf, u[None].astype(buf.dtype), i, axis=0
+        ),
+        tree,
+        update,
+    )
+
+
 def pipeline(
     stage_fn: Callable,
     mesh: Mesh,
     axis_name: str = "stage",
+    *,
+    rounds: int = 1,
+    remat: bool = False,
 ) -> Callable:
     """Build a pipelined apply from a single-stage function.
 
     Args:
-        stage_fn: ``stage_fn(stage_params, x) -> y`` with ``y`` shaped like
-            ``x`` (stages must be shape-preserving, e.g. transformer blocks).
-        mesh: mesh containing ``axis_name`` (size S = number of stages).
+        stage_fn: ``stage_fn(stage_params, x) -> y`` with ``y`` a pytree of
+            the same structure/shapes as ``x`` (the uniform wire; transformer
+            blocks are the canonical case — non-uniform edges go through
+            :func:`pipeline_with_edges`).
+        mesh: mesh containing ``axis_name`` (size S = pipeline devices).
         axis_name: the pipeline axis.
+        rounds: V virtual stages per device (circular schedule).  Total
+            stages L = V·S; ``stacked_params`` must carry L on the leading
+            dim.  V=1 is GPipe fill-drain.
+        remat: rematerialize each per-tick stage application
+            (``jax.checkpoint``) so backward residuals hold only wire
+            activations — the 1F1B activation-memory profile.
 
-    Returns ``pipelined(stacked_params, xs)`` where ``stacked_params`` leaves
-    carry a leading stage dimension [S, ...] and ``xs`` is the microbatch
-    stream [M, micro_batch, ...]; result is [M, micro_batch, ...] equal to
-    running all S stages sequentially over each microbatch.
+    Returns ``pipelined(stacked_params, xs)`` where ``stacked_params``
+    leaves carry a leading stage dimension [L, ...] and ``xs`` is the
+    microbatch stream (pytree of [M, micro_batch, ...], M ≥ S); result has
+    the shape of ``xs`` and equals running all L stages sequentially over
+    each microbatch.
     """
     S = mesh.shape[axis_name]
+    V = int(rounds)
+    if V < 1:
+        raise ValueError(f"rounds must be >= 1, got {rounds}")
+    run_stage = jax.checkpoint(stage_fn) if remat else stage_fn
 
     def per_shard(params_local, xs):
-        # params_local leaves: [1, ...] (this stage's slice) -> squeeze
-        params = jax.tree_util.tree_map(lambda a: a[0], params_local)
+        # params_local leaves: [V, 1, ...] (this device's V stage slices,
+        # shard_map leaves the sharded stage dim as size 1) -> [V, ...]
+        params_local = jax.tree_util.tree_map(lambda a: a[:, 0], params_local)
         stage = lax.axis_index(axis_name)
-        M = xs.shape[0]
-        T = M + S - 1  # fill + steady + drain ticks
+        leaves = jax.tree_util.tree_leaves(xs)
+        M = leaves[0].shape[0]
+        if V > 1 and M < S:
+            # circular timing: a parked activation must be consumed before
+            # its slot is re-parked, which needs M >= S
+            raise ValueError(
+                f"circular schedule needs at least S={S} microbatches, got {M}"
+            )
+        T = V * M + S - 1  # fill + circular steady state
         fwd = [(i, (i + 1) % S) for i in range(S)]
+        micro_like = _tree_index(xs, 0)
 
         def tick(carry, t):
-            act, outbuf = carry
-            # stage 0 ingests microbatch t (clamped during drain)
-            micro = lax.dynamic_index_in_dim(
-                xs, jnp.clip(t, 0, M - 1), axis=0, keepdims=False
+            act, queue, outbuf = carry
+            # this device's stream position / round / microbatch this tick
+            p = t - stage
+            r = jnp.clip(p // M, 0, V - 1)
+            m = jnp.clip(p - r * M, 0, M - 1)
+            # device 0 sources its input: round 0 ingests microbatch m from
+            # the stream; later rounds consume the returning activation
+            # parked in this microbatch's queue slot
+            ingest = _tree_index(xs, m)
+            if V > 1:
+                parked = _tree_index(queue, m)
+                first_in = _tree_where(r == 0, ingest, parked)
+            else:
+                first_in = ingest
+            inp = _tree_where(stage == 0, first_in, act)
+            # apply this round's stage slice
+            params_r = _tree_index(params_local, r)
+            out = run_stage(params_r, inp)
+            # the LAST device finishing round V-1 emits microbatch m
+            done = jnp.logical_and(stage == S - 1, p >= (V - 1) * M)
+            outbuf = _tree_where(done, _tree_update(outbuf, out, m), outbuf)
+            act_next = jax.tree_util.tree_map(
+                lambda a: lax.ppermute(a, axis_name, fwd), out
             )
-            inp = jnp.where(stage == 0, micro, act)
-            out = stage_fn(params, inp)
-            # the LAST stage emits microbatch t-(S-1) once the pipe is full
-            widx = t - (S - 1)
-            updated = lax.dynamic_update_slice_in_dim(
-                outbuf, out[None].astype(outbuf.dtype),
-                jnp.clip(widx, 0, M - 1), axis=0,
-            )
-            valid = jnp.logical_and(stage == S - 1, widx >= 0)
-            outbuf = jnp.where(valid, updated, outbuf)
-            act = lax.ppermute(out, axis_name, fwd)
-            return (act, outbuf), None
+            # device 0 parks the activation arriving from device S-1 (it
+            # belongs to stream position t-(S-1); consumed at tick p'+M+...,
+            # i.e. strictly later since M >= S) — only meaningful for V > 1
+            if V > 1:
+                p_in = (t + 1) - (S - 1) - 1  # position of act leaving S-1 at t
+                m_in = jnp.clip(p_in - jnp.clip(p_in // M, 0, V - 1) * M, 0, M - 1)
+                park = jnp.logical_and(stage == 0, p_in >= 0)
+                queue = _tree_where(
+                    park, _tree_update(queue, act_next, m_in), queue
+                )
+            return (act_next, queue, outbuf), None
 
-        act0 = jnp.zeros_like(xs[0])
-        outbuf0 = jnp.zeros_like(xs)
-        (act, outbuf), _ = lax.scan(tick, (act0, outbuf0), jnp.arange(T))
-        # only the last stage holds real outputs; psum replicates them
-        outbuf = jnp.where(stage == S - 1, outbuf, 0.0)
-        return lax.psum(outbuf, axis_name)
+        act0 = jax.tree_util.tree_map(jnp.zeros_like, micro_like)
+        # the return-queue (one wire slot per microbatch) only exists for
+        # the circular schedule; GPipe carries no extra state
+        queue0 = jax.tree_util.tree_map(jnp.zeros_like, xs) if V > 1 else ()
+        outbuf0 = jax.tree_util.tree_map(jnp.zeros_like, xs)
+        (act, queue, outbuf), _ = lax.scan(
+            tick, (act0, queue0, outbuf0), jnp.arange(T)
+        )
+        # only the last device holds real outputs; psum replicates them
+        outbuf = _tree_where(stage == S - 1, outbuf, jax.tree_util.tree_map(
+            jnp.zeros_like, outbuf
+        ))
+        return jax.tree_util.tree_map(
+            lambda a: lax.psum(a, axis_name), outbuf
+        )
 
     def pipelined(stacked_params, xs):
+        def _reshape(a):
+            if a.shape[0] != V * S:
+                raise ValueError(
+                    f"stacked params lead dim {a.shape[0]} != rounds×stages "
+                    f"= {V}×{S}"
+                )
+            return a.reshape(V, S, *a.shape[1:])
+
+        grouped = jax.tree_util.tree_map(_reshape, stacked_params)
         param_specs = jax.tree_util.tree_map(
-            lambda a: P(axis_name, *([None] * (a.ndim - 1))), stacked_params
+            lambda a: P(None, axis_name, *([None] * (a.ndim - 2))), grouped
         )
+        xs_specs = jax.tree_util.tree_map(lambda a: P(), xs)
         fn = shard_map(
             per_shard, mesh,
-            in_specs=(param_specs, P()),
-            out_specs=P(),
+            in_specs=(param_specs, xs_specs),
+            out_specs=xs_specs,
         )
-        return fn(stacked_params, xs)
+        return fn(grouped, xs)
 
     return pipelined
 
 
+def pipeline_with_edges(
+    first_fn: Optional[Callable],
+    stage_fn: Callable,
+    last_fn: Optional[Callable],
+    mesh: Mesh,
+    axis_name: str = "stage",
+    **pipeline_kwargs,
+) -> Callable:
+    """Pipeline with non-uniform input/output edges.
+
+    The rotating schedule needs a uniform wire, but a real model's edges are
+    not uniform (token ids → embeddings in, hidden → vocab logits out).
+    The edges run OUTSIDE the rotation, vmapped over the microbatch stream
+    (they are data-parallel work, not pipeline work):
+
+        run((first_params, last_params), stacked_params, xs)
+          == last_fn(last_params, pipeline(stage_fn)(first_fn(first_params, xs)))
+
+    ``first_fn(first_params, micro) -> wire`` and
+    ``last_fn(last_params, wire) -> out`` apply per microbatch; pass None to
+    skip an edge.
+    """
+    piped = pipeline(stage_fn, mesh, axis_name, **pipeline_kwargs)
+
+    def run(edge_params, stacked_params, xs):
+        first_params, last_params = edge_params
+        wire = (
+            jax.vmap(lambda x: first_fn(first_params, x))(xs)
+            if first_fn is not None
+            else xs
+        )
+        mid = piped(stacked_params, wire)
+        return (
+            jax.vmap(lambda a: last_fn(last_params, a))(mid)
+            if last_fn is not None
+            else mid
+        )
+
+    return run
+
+
 def stack_stage_params(param_trees) -> object:
-    """Stack S per-stage parameter pytrees into one tree with a leading
-    stage dimension (the layout :func:`pipeline` expects)."""
+    """Stack per-stage parameter pytrees into one tree with a leading stage
+    dimension (the layout :func:`pipeline` expects; for ``rounds=V`` pass
+    all L = V·S stage trees in order)."""
     return jax.tree_util.tree_map(
         lambda *leaves: jnp.stack(leaves), *param_trees
     )

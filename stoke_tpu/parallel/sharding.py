@@ -184,6 +184,38 @@ class ShardingRules:
         return NamedSharding(self.mesh, P())
 
 
+def place_global_tree(tree: Any, shardings: Any) -> Any:
+    """Place host-resident pytree leaves onto (possibly multi-host) global
+    shardings.
+
+    Single-process this is plain ``jax.device_put``.  Multi-controller JAX
+    forbids ``device_put`` of a host array onto a sharding spanning
+    non-addressable devices ("cross-host reshard"); there, each process
+    feeds its addressable shards from its full host copy via
+    ``jax.make_array_from_callback`` (every process holds the same full
+    value — the contract for initial state, replicated scalars, and
+    consolidated-checkpoint restores; the reference's per-rank
+    ``torch.load`` + broadcast plays the same role, io_ops.py:551-623).
+
+    ``shardings`` is either a pytree matching ``tree`` or one sharding
+    applied to every leaf.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(tree, shardings)
+
+    def _leaf(x, sh):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            # already a global array: reshard computationally (same device
+            # set); fetching it to host is impossible by definition
+            return jax.device_put(x, sh)
+        x = np.asarray(x)
+        return jax.make_array_from_callback(x.shape, sh, lambda idx: x[idx])
+
+    if isinstance(shardings, jax.sharding.Sharding):
+        return jax.tree_util.tree_map(lambda x: _leaf(x, shardings), tree)
+    return jax.tree_util.tree_map(_leaf, tree, shardings)
+
+
 def compile_partition_rules(rules) -> Optional[list]:
     """Compile (regex, spec-tuple) pairs into (pattern, entries-tuple).
 

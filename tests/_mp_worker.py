@@ -1,0 +1,207 @@
+"""Worker script for the 2-process CPU harness (tests/test_multiprocess.py).
+
+Each worker calls ``jax.distributed.initialize`` (explicitly, through
+``DistributedInitConfig``) against a shared coordinator, builds a Stoke run
+over the GLOBAL 8-device mesh (4 local CPU devices per process), and
+exercises one scenario named on argv.  This is the rank-coordination
+coverage the reference's IO layer is built around (reference
+io_ops.py:551-703: barrier → gather/consolidate → rank-0 write → barrier)
+and that single-process tests cannot reach.
+
+Usage: _mp_worker.py <scenario> <process_id> <num_processes> <port> <tmpdir>
+Prints ``WORKER_OK <scenario> <process_id>`` on success; any exception
+exits non-zero (the pytest side asserts both).
+"""
+
+import json
+import os
+import sys
+
+SCENARIO, PID, NPROC, PORT, TMP = (
+    sys.argv[1],
+    int(sys.argv[2]),
+    int(sys.argv[3]),
+    sys.argv[4],
+    sys.argv[5],
+)
+
+import jax  # noqa: E402  (env set by the launcher BEFORE interpreter start)
+
+# rendezvous FIRST — before anything touches the XLA backend (array
+# creation, jax.devices, ...).  The facade's initialize_distributed sees
+# "already initialized" and records it.
+jax.distributed.initialize(
+    coordinator_address=f"localhost:{PORT}",
+    num_processes=NPROC,
+    process_id=PID,
+)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from stoke_tpu import (  # noqa: E402
+    CheckpointConfig,
+    CheckpointFormat,
+    DistributedInitConfig,
+    FSDPConfig,
+    Stoke,
+    StokeOptimizer,
+)
+
+IN, OUT = 8, 4
+GLOBAL_BATCH = 32
+
+
+def make_stoke(fmt=CheckpointFormat.consolidated, fsdp=False):
+    params = {
+        "w": jnp.asarray(
+            np.random.default_rng(7).normal(size=(IN, OUT)).astype(np.float32) * 0.1
+        )
+    }
+    cfgs = [
+        DistributedInitConfig(
+            coordinator_address=f"localhost:{PORT}",
+            num_processes=NPROC,
+            process_id=PID,
+        ),
+        CheckpointConfig(format=fmt),
+    ]
+    if fsdp:
+        cfgs.append(FSDPConfig(min_weight_size=1))
+    return Stoke(
+        model=lambda p, x: x @ p["w"],
+        optimizer=StokeOptimizer(
+            optimizer=optax.adam, optimizer_kwargs={"learning_rate": 1e-2}
+        ),
+        loss=lambda o, y: jnp.mean((o - y) ** 2),
+        params=params,
+        batch_size_per_device=GLOBAL_BATCH // 8,
+        distributed="dp",
+        fsdp=fsdp,
+        verbose=False,
+        configs=cfgs,
+    )
+
+
+def local_batch(step: int):
+    """This process's contiguous slice of the deterministic global batch
+    (the contract of per-process feeding: process p holds rows
+    [p*local : (p+1)*local] of the logically-global batch)."""
+    r = np.random.default_rng(100 + step)
+    x = r.normal(size=(GLOBAL_BATCH, IN)).astype(np.float32)
+    W = np.ones((IN, OUT), np.float32)
+    y = (x @ W).astype(np.float32)
+    local = GLOBAL_BATCH // NPROC
+    sl = slice(PID * local, (PID + 1) * local)
+    return x[sl], y[sl]
+
+
+def train(s, steps=3):
+    for i in range(steps):
+        x, y = local_batch(i)
+        out = s.model(x)
+        loss = s.loss(out, y)
+        s.backward(loss)
+        s.step()
+    return s
+
+
+def main():
+    if SCENARIO == "train_equiv":
+        # 2-proc dp training over per-process local slices; every process
+        # must hold identical (replicated) updated params, and they must
+        # match the single-process reference (written by the pytest side)
+        s = train(make_stoke())
+        assert jax.process_count() == NPROC
+        w = np.asarray(jax.device_get(s.params["w"]))
+        np.save(os.path.join(TMP, f"params_p{PID}.npy"), w)
+        # synced loss is a plain host float on every process
+        l = s.loss(s.model(local_batch(0)[0]), local_batch(0)[1])
+        _ = s.detach_and_sync_loss(l)
+
+    elif SCENARIO == "consolidated_save":
+        # gather + process-0 write (reference DDPIO torch.save on rank 0,
+        # io_ops.py:551-623) with barriers on both sides
+        s = train(make_stoke())
+        tag_dir = s.save(os.path.join(TMP, "ckpt"), name="mp")
+        s.barrier()
+        if PID == 0:
+            assert os.path.exists(os.path.join(tag_dir, "variables.npz"))
+            assert os.path.exists(os.path.join(tag_dir, "meta.json"))
+        # every process loads the consolidated file back identically
+        s2 = make_stoke()
+        s2.load(os.path.join(TMP, "ckpt"), name="mp")
+        assert s2.backward_steps == 3 and s2.optimizer_steps == 3
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(s2.params["w"])),
+            np.asarray(jax.device_get(s.params["w"])),
+            rtol=1e-6,
+        )
+
+    elif SCENARIO == "sharded_save":
+        # every host writes its shards via orbax/tensorstore (reference
+        # DeepspeedIO sharded path, io_ops.py:389-483), fsdp placement
+        from jax.experimental import multihost_utils
+
+        s = train(make_stoke(fmt=CheckpointFormat.sharded, fsdp=True))
+        s.save(os.path.join(TMP, "ckpt_sharded"), name="mp")
+        s.barrier()
+        s2 = make_stoke(fmt=CheckpointFormat.sharded, fsdp=True)
+        s2.load(os.path.join(TMP, "ckpt_sharded"), name="mp")
+        # fsdp params span non-addressable devices: gather to compare
+        a = multihost_utils.process_allgather(s.params["w"], tiled=True)
+        b = multihost_utils.process_allgather(s2.params["w"], tiled=True)
+        np.testing.assert_allclose(b, a, rtol=1e-6)
+
+    elif SCENARIO == "loader":
+        # multi-process DataLoader REQUIRES a distributed sampler
+        # (reference stoke.py:822-826); with one, processes see disjoint
+        # shards that cover the dataset
+        from stoke_tpu.data import BucketedDistributedSampler
+
+        s = make_stoke()
+        data = [(np.full((IN,), i, np.float32), np.float32(i)) for i in range(256)]
+        try:
+            s.DataLoader(data)
+            raise AssertionError("sampler-less multi-process loader accepted")
+        except ValueError as e:
+            assert "sampler" in str(e)
+        sampler = BucketedDistributedSampler(
+            data,
+            buckets=1,
+            batch_size=8,
+            sorted_idx=list(range(256)),
+            num_replicas=NPROC,
+            rank=PID,
+            info_rank=0,
+        )
+        # the loader accepts the sampler and yields device-placed batches:
+        # per-process loader batch = batch_size_per_device × local devices
+        # (16), assembled into the logically-GLOBAL array (32)
+        loader = s.DataLoader(data, sampler=sampler)
+        assert loader.batch_size == 16, loader.batch_size
+        first = next(iter(loader))
+        assert first[0].shape[0] == 32, first[0].shape
+        seen = list(sampler)
+        with open(os.path.join(TMP, f"shard_p{PID}.json"), "w") as f:
+            json.dump(sorted(seen), f)
+
+    elif SCENARIO == "batch_divisible":
+        # indivisible per-process batches must raise (not silently mix)
+        s = make_stoke()
+        x = np.zeros((GLOBAL_BATCH // NPROC + 1, IN), np.float32)
+        try:
+            s._place_batch(x)
+            raise AssertionError("indivisible per-process batch accepted")
+        except ValueError as e:
+            assert "per-process" in str(e)
+
+    else:
+        raise SystemExit(f"unknown scenario {SCENARIO}")
+
+    print(f"WORKER_OK {SCENARIO} {PID}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
