@@ -1,0 +1,40 @@
+"""Shared TPU-tunnel supervisor for the measurement scripts.
+
+The remote-TPU tunnel in this environment is single-client and can wedge; a
+wedged tunnel hangs ANY process at jax backend init.  ``supervise`` never
+imports jax itself: it pre-probes the device in a timeboxed subprocess, then
+runs the real measurement (``<script> --_worker ...``) under a watchdog, so
+callers always get an error line instead of a hang (BENCH_NOTES.md "Tunnel
+discipline").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+
+def supervise(script_file: str, argv, watchdog_seconds: int = 2400) -> int:
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            capture_output=True, text=True, timeout=120,
+        )
+        if probe.returncode != 0:
+            raise RuntimeError(
+                (probe.stderr or "device probe failed").strip().splitlines()[-1][:200]
+            )
+    except (subprocess.TimeoutExpired, RuntimeError) as e:
+        print(json.dumps({"error": f"device probe failed: {e}"[:250]}))
+        return 1
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(script_file), "--_worker", *argv],
+            text=True, timeout=watchdog_seconds,
+        )
+        return out.returncode
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"error": f"timed out after {watchdog_seconds}s"}))
+        return 1

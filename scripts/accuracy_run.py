@@ -1,0 +1,164 @@
+"""Accuracy validation through the full training path (north-star proxy).
+
+BASELINE.md's north star includes "top-1 accuracy parity" on CIFAR-10
+ResNet-50 — but this environment has no real CIFAR-10 (zero egress; the
+example falls back to synthetic data).  This script records REAL-data
+accuracy through the exact same code path (Stoke facade, fused micro-step,
+bf16 policy, ResNet) on the one real dataset available offline
+(sklearn's handwritten digits, 1797 samples, 10 classes, upscaled 8x8→32x32)
+plus a synthetic-CIFAR overfit check (loss → ~0 proves the optimizer/grad
+path end-to-end).
+
+Prints one JSON line per phase.  Run on TPU or CPU:
+    python scripts/accuracy_run.py [--model resnet18|resnet50] [--epochs N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_digits_32():
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    x = d.images.astype(np.float32) / 16.0  # [N, 8, 8] in [0, 1]
+    x = np.kron(x, np.ones((1, 4, 4), np.float32))  # upscale to 32x32
+    x = np.repeat(x[..., None], 3, axis=-1)  # fake RGB
+    y = d.target.astype(np.int64)
+    rng = np.random.default_rng(0)
+    order = rng.permutation(len(x))
+    x, y = x[order], y[order]
+    n_test = 297
+    return (x[n_test:], y[n_test:]), (x[:n_test], y[:n_test])
+
+
+def build(model_name, num_classes, lr, steps_per_epoch, epochs):
+    import jax
+    import optax
+
+    from stoke_tpu import Stoke, StokeOptimizer
+    from stoke_tpu.models import ResNet18, ResNet50
+    from stoke_tpu.utils import init_module
+
+    model = (ResNet18 if model_name == "resnet18" else ResNet50)(
+        num_classes=num_classes, cifar_stem=True
+    )
+    variables = init_module(
+        model, jax.random.PRNGKey(0), np.zeros((2, 32, 32, 3), np.float32),
+        train=False,
+    )
+    sched = optax.cosine_decay_schedule(lr, steps_per_epoch * epochs)
+    on_accel = jax.default_backend() not in ("cpu",)
+    return Stoke(
+        model=model,
+        optimizer=StokeOptimizer(
+            optimizer=optax.sgd,
+            optimizer_kwargs={"learning_rate": sched, "momentum": 0.9},
+        ),
+        loss=lambda lg, y: optax.softmax_cross_entropy_with_integer_labels(
+            lg, y
+        ).mean(),
+        params=variables,
+        batch_size_per_device=128,
+        device="tpu" if on_accel else "cpu",
+        precision="bf16" if on_accel else None,
+        model_train_kwargs={"train": True},
+        model_eval_kwargs={"train": False},
+        verbose=False,
+    )
+
+
+def evaluate(stoke, x, y, batch=128):
+    import jax.numpy as jnp
+
+    stoke.eval()
+    correct = 0
+    for i in range(0, len(x) - batch + 1, batch):
+        out = stoke.model(x[i : i + batch])
+        arr = np.asarray(out.value if hasattr(out, "value") else out)
+        correct += int((arr.argmax(-1) == y[i : i + batch]).sum())
+    n = (len(x) // batch) * batch
+    stoke.train()
+    return correct / max(n, 1)
+
+
+def run_digits(model_name, epochs):
+    (xt, yt), (xv, yv) = load_digits_32()
+    batch = 128
+    spe = len(xt) // batch
+    stoke = build(model_name, 10, 0.02, spe, epochs)
+    rng = np.random.default_rng(1)
+    t0 = time.time()
+    for ep in range(epochs):
+        order = rng.permutation(len(xt))
+        for i in range(spe):
+            idx = order[i * batch : (i + 1) * batch]
+            stoke.train_step(xt[idx], (yt[idx],))
+    stoke.block_until_ready()
+    wall = time.time() - t0
+    acc = evaluate(stoke, xv, yv)
+    print(json.dumps({
+        "phase": "digits_real_data", "model": model_name, "epochs": epochs,
+        "train_n": len(xt), "test_n": len(xv),
+        "top1": round(acc, 4), "wall_s": round(wall, 1),
+        "ema_loss": round(float(stoke.ema_loss), 4),
+    }), flush=True)
+    return acc
+
+
+def run_synthetic_overfit(model_name):
+    """Memorize 512 random-label synthetic CIFAR images: loss -> ~0 and
+    train-acc -> 1.0 proves the full grad/update path."""
+    rng = np.random.default_rng(2)
+    n = 512
+    x = rng.normal(size=(n, 32, 32, 3)).astype(np.float32)
+    y = rng.integers(0, 10, size=(n,)).astype(np.int64)
+    batch = 128
+    spe = n // batch
+    epochs = 60
+    stoke = build(model_name, 10, 0.05, spe, epochs)
+    t0 = time.time()
+    for ep in range(epochs):
+        for i in range(spe):
+            stoke.train_step(x[i * batch : (i + 1) * batch],
+                             (y[i * batch : (i + 1) * batch],))
+    stoke.block_until_ready()
+    wall = time.time() - t0
+    acc = evaluate(stoke, x, y)
+    print(json.dumps({
+        "phase": "synthetic_cifar_overfit", "model": model_name,
+        "n": n, "epochs": epochs, "train_top1": round(acc, 4),
+        "ema_loss": round(float(stoke.ema_loss), 4),
+        "wall_s": round(wall, 1),
+    }), flush=True)
+    return acc
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50",
+                    choices=["resnet18", "resnet50"])
+    ap.add_argument("--epochs", type=int, default=30)
+    ap.add_argument("--skip-overfit", action="store_true")
+    ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
+    args = ap.parse_args()
+    if not args._worker:
+        from _supervise import supervise
+
+        sys.exit(supervise(__file__, sys.argv[1:]))
+    acc = run_digits(args.model, args.epochs)
+    ok = acc >= 0.95
+    if not args.skip_overfit:
+        oacc = run_synthetic_overfit(args.model)
+        ok = ok and oacc >= 0.99
+    print(json.dumps({"accuracy_gate": "pass" if ok else "FAIL"}))
+    sys.exit(0 if ok else 1)

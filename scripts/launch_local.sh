@@ -1,0 +1,60 @@
+#!/usr/bin/env bash
+# Multi-host smoke launch WITHOUT hardware: N local processes, each with K
+# simulated CPU devices, rendezvousing over a localhost coordinator — the
+# same code path a real TPU pod takes (docs/multihost.md "Testing multi-host
+# paths without a pod"). Use this to validate your own multi-host training
+# script on a laptop/CI before paying for a pod.
+#
+# Usage: scripts/launch_local.sh [-n NUM_PROCS] [-d DEVICES_PER_PROC] CMD...
+#   CMD runs once per process with STOKE_PROCESS_ID / STOKE_NUM_PROCESSES /
+#   JAX_COORDINATOR_ADDRESS exported; pass these to DistributedInitConfig
+#   (or call jax.distributed.initialize yourself — before any other JAX API).
+#
+# Example (the in-repo worker used by tests/test_multiprocess.py):
+#   scripts/launch_local.sh -n 2 -d 4 python tests/_mp_worker.py train_equivalence /tmp/out
+set -euo pipefail
+
+NPROC=2
+NDEV=4
+while getopts "n:d:" opt; do
+  case "$opt" in
+    n) NPROC="$OPTARG" ;;
+    d) NDEV="$OPTARG" ;;
+    *) exit 1 ;;
+  esac
+done
+shift $((OPTIND - 1))
+[[ $# -gt 0 ]] || { echo "usage: $0 [-n N] [-d K] CMD..." >&2; exit 1; }
+
+PORT=$(( (RANDOM % 20000) + 20000 ))
+export JAX_COORDINATOR_ADDRESS="127.0.0.1:$PORT"
+export STOKE_NUM_PROCESSES="$NPROC"
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=$NDEV"
+
+pids=()
+cleanup() {
+  for pid in "${pids[@]}"; do
+    kill "$pid" 2>/dev/null || true
+  done
+}
+trap cleanup EXIT INT TERM
+
+for ((i = 1; i < NPROC; i++)); do
+  STOKE_PROCESS_ID="$i" "$@" &
+  pids+=($!)
+done
+status=0
+# rank 0 failing must not orphan the workers (they would hang forever in
+# rendezvous waiting for the dead coordinator) — collect its status, then
+# wait for / reap everyone
+STOKE_PROCESS_ID=0 "$@" || status=$?
+if [[ "$status" -ne 0 ]]; then
+  # coordinator died: workers would block in rendezvous forever
+  cleanup
+fi
+for pid in "${pids[@]}"; do
+  wait "$pid" || status=$?
+done
+pids=()
+exit "$status"

@@ -1,0 +1,83 @@
+#!/usr/bin/env bash
+# One-command multi-host TPU pod bring-up for stoke_tpu.
+#
+# TPU translation of the reference's launcher story (docs/Launchers.md:
+# torchrun / horovodrun / mpirun + docker/stoke-gpu-mpi.Dockerfile): on
+# Cloud TPU there is no launcher zoo — `gcloud ... ssh --worker=all` starts
+# ONE process per host and `jax.distributed.initialize()` (called inside
+# Stoke.__init__) rendezvouses via the TPU metadata server.
+#
+# Usage:
+#   scripts/launch_tpu_pod.sh create            # provision the pod slice
+#   scripts/launch_tpu_pod.sh setup             # rsync repo + pip install on all workers
+#   scripts/launch_tpu_pod.sh run CMD...        # run CMD on all workers simultaneously
+#   scripts/launch_tpu_pod.sh train             # run the CIFAR-10 DP example
+#   scripts/launch_tpu_pod.sh delete            # tear down
+#
+# Every gcloud invocation honors DRY_RUN=1 (print, don't execute), so the
+# full bring-up is reviewable/dry-runnable without a GCP project:
+#   DRY_RUN=1 scripts/launch_tpu_pod.sh create setup train
+#
+# Config via env (defaults target a v5e-16 slice = 4 hosts x 4 chips):
+set -euo pipefail
+
+TPU_NAME="${TPU_NAME:-stoke-tpu-pod}"
+ZONE="${ZONE:-us-west4-a}"
+ACCELERATOR_TYPE="${ACCELERATOR_TYPE:-v5litepod-16}"
+RUNTIME_VERSION="${RUNTIME_VERSION:-v2-alpha-tpuv5-lite}"
+PROJECT_ARGS=${PROJECT:+--project "$PROJECT"}
+REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+REMOTE_DIR="${REMOTE_DIR:-stoke_tpu}"
+
+gcloud_tpu() {
+  if [[ "${DRY_RUN:-0}" == "1" ]]; then
+    echo "+ gcloud compute tpus tpu-vm $*"
+  else
+    # shellcheck disable=SC2086
+    gcloud compute tpus tpu-vm "$@" $PROJECT_ARGS
+  fi
+}
+
+cmd_create() {
+  gcloud_tpu create "$TPU_NAME" \
+    --zone "$ZONE" \
+    --accelerator-type "$ACCELERATOR_TYPE" \
+    --version "$RUNTIME_VERSION"
+}
+
+cmd_setup() {
+  # rsync the repo to every worker, then install deps + the package.
+  gcloud_tpu scp --recurse --worker=all --zone "$ZONE" \
+    "$REPO_ROOT" "$TPU_NAME":"$REMOTE_DIR"
+  gcloud_tpu ssh "$TPU_NAME" --worker=all --zone "$ZONE" --command \
+    "pip install -q 'jax[tpu]' -f https://storage.googleapis.com/jax-releases/libtpu_releases.html && pip install -q -e $REMOTE_DIR"
+}
+
+cmd_run() {
+  # Simultaneous one-process-per-host launch; rendezvous is automatic.
+  gcloud_tpu ssh "$TPU_NAME" --worker=all --zone "$ZONE" --command \
+    "cd $REMOTE_DIR && $*"
+}
+
+cmd_train() {
+  cmd_run "python examples/cifar10/train.py --config examples/cifar10/config/dp_bf16.yaml"
+}
+
+cmd_delete() {
+  gcloud_tpu delete "$TPU_NAME" --zone "$ZONE" --quiet
+}
+
+if [[ $# -eq 0 ]]; then
+  sed -n '2,20p' "$0"
+  exit 1
+fi
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    create) cmd_create; shift ;;
+    setup) cmd_setup; shift ;;
+    train) cmd_train; shift ;;
+    delete) cmd_delete; shift ;;
+    run) shift; cmd_run "$@"; break ;;
+    *) echo "unknown command: $1" >&2; exit 1 ;;
+  esac
+done

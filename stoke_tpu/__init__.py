@@ -19,6 +19,7 @@ from stoke_tpu.configs import (
     FSDPConfig,
     LossReduction,
     MeshConfig,
+    OffloadDiskConfig,
     OffloadOptimizerConfig,
     OffloadParamsConfig,
     OSSConfig,
@@ -79,6 +80,7 @@ __all__ = [
     "OSSConfig",
     "SDDPConfig",
     "FSDPConfig",
+    "OffloadDiskConfig",
     "OffloadOptimizerConfig",
     "OffloadParamsConfig",
     "PartitionRulesConfig",

@@ -452,6 +452,14 @@ class StepEngine:
         self._repl = self.rules.replicated()
         return place_global_tree(variables, self._var_shardings)
 
+    def _nonparam_device_shardings(self):
+        """Device shardings of the mutable (non-param) collections — the
+        ``updated`` output of the accum/fused steps (engine.py:105 makes every
+        non-param collection mutable)."""
+        return {
+            k: v for k, v in self._var_device_shardings.items() if k != "params"
+        }
+
     def _offload_shardings(self, shardings, cfg, what: str):
         """Re-target a sharding tree to host memory
         (``memory_kind="pinned_host"``) — the ZeRO-offload equivalent
@@ -710,7 +718,12 @@ class StepEngine:
             repl = self._repl
             out_sh = (
                 None,  # loss report: let XLA keep it replicated (scalars)
-                None,  # updated non-param collections: follow inputs
+                # updated non-param collections (BN stats etc.): pin to the
+                # tier placement — left unconstrained, GSPMD shards them to
+                # match the data-sharded activations they were reduced from,
+                # which then defeats buffer donation (and forces a reshard)
+                # at the apply boundary where the tier placement is required
+                self._nonparam_device_shardings(),
                 self._grad_shardings,
                 repl,  # rng
             )
@@ -898,51 +911,89 @@ class StepEngine:
             self._accum_cache[key] = self._build_fused(
                 loss_treedef, deferred_info, bool(do_apply)
             )
-        return self._accum_cache[key](
-            variables, opt_state, grad_buf, scaler_state, rng, margs, mkwargs,
+        if do_apply:
+            return self._accum_cache[key](
+                variables, opt_state, grad_buf, scaler_state, rng, margs,
+                mkwargs, loss_args_flat,
+            )
+        # non-boundary micro-steps never touch the optimizer state: it stays
+        # wherever it lives (device, pinned host, or the disk tier) and the
+        # caller's reference is echoed untouched
+        (report, updated, new_vars, new_buf, new_scaler, new_rng,
+         finite) = self._accum_cache[key](
+            variables, grad_buf, scaler_state, rng, margs, mkwargs,
             loss_args_flat,
         )
+        return (report, updated, new_vars, opt_state, new_buf, new_scaler,
+                new_rng, finite)
 
     def _build_fused(self, loss_treedef, deferred_info, do_apply):
         accum = self._accum_core(loss_treedef, deferred_info, training=True)
         apply_core = self._apply_core()
 
-        def _fused(variables, opt_state, grad_buf, scaler_state, rng, margs,
-                   mkwargs, larr):
-            # host-offloaded params → HBM ONCE for both accum and apply (the
-            # cores' own transfers become no-ops on already-device params)
-            variables = self._vars_to_compute(variables)
-            report, updated, new_buf, new_rng = accum(
-                variables, grad_buf, scaler_state, rng, margs, mkwargs, larr
-            )
-            merged = {**variables, **updated}
-            if do_apply:
+        if do_apply:
+
+            def _fused(variables, opt_state, grad_buf, scaler_state, rng,
+                       margs, mkwargs, larr):
+                # host-offloaded params → HBM ONCE for both accum and apply
+                # (the cores' own transfers become no-ops on already-device
+                # params)
+                variables = self._vars_to_compute(variables)
+                report, updated, new_buf, new_rng = accum(
+                    variables, grad_buf, scaler_state, rng, margs, mkwargs,
+                    larr
+                )
+                merged = {**variables, **updated}
                 new_vars, new_opt, zero_buf, new_scaler, finite = apply_core(
                     merged, opt_state, new_buf, scaler_state
                 )
                 return (report, updated, new_vars, new_opt, zero_buf,
                         new_scaler, new_rng, finite)
-            return (report, updated, merged, opt_state, new_buf, scaler_state,
-                    new_rng, jnp.asarray(True))
+
+            if self.rules is not None:
+                repl = self._repl
+                out_sh = (
+                    None,  # report
+                    self._nonparam_device_shardings(),  # updated collections
+                    self._var_shardings,
+                    self._opt_shardings,
+                    self._grad_shardings,
+                    {"scale": repl, "growth_count": repl},
+                    repl,  # rng
+                    repl,  # finite
+                )
+                return jax.jit(
+                    _fused, out_shardings=out_sh, donate_argnums=(0, 1, 2)
+                )
+            return jax.jit(_fused, donate_argnums=(0, 1, 2))
+
+        def _fused_nb(variables, grad_buf, scaler_state, rng, margs, mkwargs,
+                      larr):
+            variables = self._vars_to_compute(variables)
+            report, updated, new_buf, new_rng = accum(
+                variables, grad_buf, scaler_state, rng, margs, mkwargs, larr
+            )
+            merged = {**variables, **updated}
+            return (report, updated, merged, new_buf, scaler_state, new_rng,
+                    jnp.asarray(True))
 
         if self.rules is not None:
             repl = self._repl
             out_sh = (
                 None,  # report
-                None,  # updated collections
+                self._nonparam_device_shardings(),  # updated collections
                 # non-boundary micro-steps leave params in device memory:
                 # writing the UNCHANGED params back to pinned_host (and in
                 # again next micro-step) would be a pure host<->HBM round
                 # trip; only the boundary step persists to the offload tier
-                self._var_shardings if do_apply else self._var_device_shardings,
-                self._opt_shardings,
+                self._var_device_shardings,
                 self._grad_shardings,
                 {"scale": repl, "growth_count": repl},
                 repl,  # rng
                 repl,  # finite
             )
-            return jax.jit(_fused, out_shardings=out_sh, donate_argnums=(0, 1, 2))
-        return jax.jit(_fused, donate_argnums=(0, 1, 2))
+            return jax.jit(_fused_nb, out_shardings=out_sh, donate_argnums=(0, 1))
+        return jax.jit(_fused_nb, donate_argnums=(0, 1))
 
     # --------------------------- loss-only ----------------------------- #
 

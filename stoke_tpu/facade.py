@@ -24,6 +24,7 @@ the collective schedule.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Callable, Dict, Optional, Sequence, Union
 
@@ -229,6 +230,40 @@ class Stoke:
             with jax.default_device(self._device):
                 opt_state = self._optimizer.init(self._variables["params"])
             self._opt_state = jax.device_put(opt_state, opt_target)
+        # disk tier (NVMe-offload equivalent): spill the freshly initialized
+        # optimizer state immediately — it is only needed again at the first
+        # accumulation boundary
+        self._disk_store = None
+        if st.offload_disk_config is not None:
+            import tempfile
+
+            from stoke_tpu.offload import DiskOptimizerStore
+
+            if st.offload_disk_config.path is not None:
+                # unique per process AND per instance/run: concurrent runs
+                # pointing at the same NVMe mount must not clobber each other
+                base = os.path.join(
+                    st.offload_disk_config.path, f"proc{jax.process_index()}"
+                )
+                os.makedirs(base, exist_ok=True)
+                # a killed run cannot clean its spill — reclaim siblings
+                # whose recorded pid is dead before adding ours
+                from stoke_tpu.offload import reclaim_stale_spills
+
+                reclaim_stale_spills(base)
+                spill_dir = tempfile.mkdtemp(prefix="run-", dir=base)
+            else:
+                spill_dir = tempfile.mkdtemp(prefix="stoke-optspill-")
+            with open(os.path.join(spill_dir, "pid"), "w") as f:
+                f.write(str(os.getpid()))
+            self._disk_store = DiskOptimizerStore(
+                os.path.join(spill_dir, "opt"), cleanup_root=spill_dir
+            )
+            # protect the model variables: some optax transforms alias params
+            # inside their init state, and deleting those buffers would kill
+            # the live model
+            self._disk_store.store(self._opt_state, protect=self._variables)
+            self._opt_state = None
         self._grad_buf = self._engine.init_grad_buffer(self._variables)
         self._scaler_state = self._place_scalar_tree(
             init_scaler_state(st.precision_config)
@@ -301,6 +336,22 @@ class Stoke:
                 )
                 return self._device
             raise
+
+    def _opt_materialize(self):
+        """Optimizer state as device arrays (reads the disk tier if the
+        state is spilled; otherwise the live tree)."""
+        if self._disk_store is not None and self._disk_store.spilled:
+            return self._disk_store.load()
+        return self._opt_state
+
+    def _opt_commit(self, new_opt) -> None:
+        """Hand updated optimizer state back to its tier (disk spill or the
+        live facade slot)."""
+        if self._disk_store is not None:
+            self._disk_store.store(new_opt, protect=self._variables)
+            self._opt_state = None
+        else:
+            self._opt_state = new_opt
 
     def _zero_scalar(self):
         # np scalar: creation must not touch the default accelerator backend
@@ -558,13 +609,17 @@ class Stoke:
             return
         (
             self._variables,
-            self._opt_state,
+            new_opt,
             self._grad_buf,
             self._scaler_state,
             finite,
         ) = self._engine.apply_step(
-            self._variables, self._opt_state, self._grad_buf, self._scaler_state
+            self._variables,
+            self._opt_materialize(),
+            self._grad_buf,
+            self._scaler_state,
         )
+        self._opt_commit(new_opt)
         if self._precision.scaled:
             self._skipped_steps = self._skipped_steps + (
                 1.0 - finite.astype(jnp.float32)
@@ -623,14 +678,14 @@ class Stoke:
             report,
             _updated,
             self._variables,
-            self._opt_state,
+            new_opt,
             self._grad_buf,
             self._scaler_state,
             self._rng,
             finite,
         ) = self._engine.fused_step(
             self._variables,
-            self._opt_state,
+            self._opt_materialize() if do_apply else self._opt_state,
             self._grad_buf,
             self._scaler_state,
             self._rng,
@@ -641,6 +696,10 @@ class Stoke:
             deferred_info,
             do_apply,
         )
+        if do_apply:
+            self._opt_commit(new_opt)
+        else:
+            self._opt_state = new_opt
         self._pending = None
         self._backward_steps += 1
         self._update_loss_tracking(report)
@@ -797,14 +856,14 @@ class Stoke:
         (
             reports,
             self._variables,
-            self._opt_state,
+            new_opt,
             self._grad_buf,
             self._scaler_state,
             self._rng,
             finite,
         ) = self._engine.window_step(
             self._variables,
-            self._opt_state,
+            self._opt_materialize(),
             self._grad_buf,
             self._scaler_state,
             self._rng,
@@ -814,6 +873,7 @@ class Stoke:
             treedef,
             deferred_info,
         )
+        self._opt_commit(new_opt)
         self._pending = None
         self._backward_steps += k
         # track the window-mean micro loss once (per-micro EMA would need k
@@ -1073,9 +1133,15 @@ class Stoke:
             (i, l._path) for i, l in enumerate(flat) if is_deferred(l)
         )
         fn = self._engine._build_fused(treedef, deferred_info, True)
+        # abstract avals for spilled state: lowering must not page the whole
+        # optimizer state back into HBM just to trace shapes
+        if self._disk_store is not None and self._disk_store.spilled:
+            opt_arg = self._disk_store.abstract()
+        else:
+            opt_arg = self._opt_state
         lowered = fn.lower(
             self._variables,
-            self._opt_state,
+            opt_arg,
             self._grad_buf,
             self._scaler_state,
             self._rng,
@@ -1147,7 +1213,7 @@ class Stoke:
             path=path,
             name=name,
             variables=self._variables,
-            opt_state=self._opt_state,
+            opt_state=self._opt_materialize(),
             scaler_state=self._scaler_state,
             counters={
                 "backward_step": self._backward_steps,
@@ -1173,18 +1239,25 @@ class Stoke:
         if the checkpoint carries none, the window restarts cleanly."""
         from stoke_tpu import io_ops
 
+        # abstract avals when spilled: the restore template only needs
+        # shape/dtype/sharding, and materializing would put ~2x the state in
+        # HBM during restore — the exact memory the disk tier exists to avoid
+        if self._disk_store is not None and self._disk_store.spilled:
+            opt_like = self._disk_store.abstract()
+        else:
+            opt_like = self._opt_state
         payload = io_ops.load_checkpoint(
             path=path,
             tag=tag,
             variables_like=self._variables,
-            opt_state_like=self._opt_state,
+            opt_state_like=opt_like,
             scaler_like=self._scaler_state,
             config=self._status_obj.checkpoint_config,
             name=name if tag is None else None,
             grad_buf_like=self._grad_buf,
         )
         self._variables = payload["variables"]
-        self._opt_state = payload["opt_state"]
+        self._opt_commit(payload["opt_state"])
         self._scaler_state = payload["scaler_state"]
         counters = payload["counters"]
         self._backward_steps = counters["backward_step"]
@@ -1236,7 +1309,7 @@ class Stoke:
 
     @property
     def opt_state(self) -> Any:
-        return self._opt_state
+        return self._opt_materialize()
 
     @property
     def scaler(self) -> Dict[str, Any]:

@@ -392,6 +392,31 @@ def flash_attention(
     return out.reshape(B, H, L, D)
 
 
+#: numerics-contract tolerances for validating the kernel against the dense
+#: reference at bf16 inputs (shared by tests/test_flash_tpu.py and
+#: scripts/flash_tpu_check.py so the pytest gate and the standalone on-TPU
+#: check can never disagree)
+FWD_ATOL_BF16 = 2e-2
+BWD_RTOL_BF16 = 0.05
+
+
+def dense_reference(q, k, v, mask=None, causal=False):
+    """O(L²) dense attention in fp32 — the ground truth the flash kernel is
+    validated against ([B, H, L, D] inputs, optional [B, L] key mask)."""
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) / (q.shape[-1] ** 0.5)
+    L = q.shape[2]
+    if mask is not None:
+        s = jnp.where(mask[:, None, None, :] > 0, s, _NEG_INF)
+    if causal:
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (L, L), 0)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (L, L), 1)
+        s = jnp.where((qpos >= kpos)[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+
+
 def make_flash_attention(
     causal: bool = False, block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K, interpret: Optional[bool] = None,

@@ -34,6 +34,7 @@ from stoke_tpu.configs import (
     DistributedOptions,
     FSDPConfig,
     MeshConfig,
+    OffloadDiskConfig,
     OffloadOptimizerConfig,
     OffloadParamsConfig,
     OSSConfig,
@@ -296,6 +297,12 @@ class StokeStatus:
         def _param_offload_requires_fsdp(s):
             return "OffloadParamsConfig" in self._configs and not s["fsdp"]
 
+        def _offload_tier_conflict(s):
+            return (
+                "OffloadDiskConfig" in self._configs
+                and "OffloadOptimizerConfig" in self._configs
+            )
+
         return [
             (
                 lambda s: s["batch_size_per_device"] is None
@@ -379,6 +386,12 @@ class StokeStatus:
                 "OffloadParamsConfig requires fsdp=True — parameter offload "
                 "is a ZeRO-3 feature (reference DeepspeedOffloadParamConfig "
                 "legal only at stage 3, configs.py:346-372)",
+            ),
+            (
+                _offload_tier_conflict,
+                "OffloadDiskConfig and OffloadOptimizerConfig are mutually "
+                "exclusive — one offload tier per state (reference: a single "
+                "offload_optimizer device choice, configs.py:309-343)",
             ),
         ]
 
@@ -541,6 +554,12 @@ class StokeStatus:
         """None unless explicitly supplied (param offload is opt-in and
         fsdp-only, reference configs.py:346-372)."""
         return self._configs.get("OffloadParamsConfig")
+
+    @property
+    def offload_disk_config(self):
+        """None unless explicitly supplied (disk/NVMe tier is opt-in,
+        reference DeepspeedAIOConfig configs.py:192-221)."""
+        return self._configs.get("OffloadDiskConfig")
 
     @property
     def activation_checkpointing_config(self) -> Optional[ActivationCheckpointingConfig]:

@@ -8,7 +8,13 @@ jax initializes a backend, hence the top-level env mutation.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+# STOKE_TEST_TPU=1 opts OUT of the cpu forcing so the on-hardware modules
+# (tests/test_flash_tpu.py) can reach the real chip:
+#   STOKE_TEST_TPU=1 python -m pytest tests/test_flash_tpu.py -q
+_want_tpu = os.environ.get("STOKE_TEST_TPU") == "1"
+
+if not _want_tpu:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -20,12 +26,13 @@ if "xla_force_host_platform_device_count" not in flags:
 # locking in JAX_PLATFORMS and a plugin whose backend init can HANG when the
 # remote tunnel is unreachable.  Force the cpu platform at the config level
 # and drop non-cpu backend factories so the suite never touches the tunnel.
-try:  # pragma: no cover - environment-specific hardening
-    import jax
+if not _want_tpu:
+    try:  # pragma: no cover - environment-specific hardening
+        import jax
 
-    jax.config.update("jax_platforms", "cpu")
-except Exception:
-    pass
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402

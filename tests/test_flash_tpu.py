@@ -1,0 +1,62 @@
+"""Real-TPU validation of the Pallas flash-attention kernel.
+
+The CPU suite exercises the same kernels through the pallas interpreter
+(tests/test_attention.py); these tests compile the real Mosaic kernels and
+therefore ONLY run when a TPU backend is present (conftest.py forces the cpu
+platform for the rest of the suite, so this module must be run explicitly:
+
+    STOKE_TEST_TPU=1 python -m pytest tests/test_flash_tpu.py -q
+
+The standalone runner `scripts/flash_tpu_check.py` performs the same checks
+plus a flash-vs-dense microbenchmark; results are recorded in BENCH_NOTES.md.
+Both validate against the same `dense_reference` and tolerances
+(stoke_tpu/ops/flash_attention.py) so the gate and the check cannot diverge.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "tpu", reason="requires a real TPU backend"
+)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("masked", [False, True])
+def test_flash_matches_dense_on_tpu(causal, masked):
+    from stoke_tpu.ops.flash_attention import (
+        BWD_RTOL_BF16,
+        FWD_ATOL_BF16,
+        dense_reference,
+        flash_attention,
+    )
+
+    r = np.random.default_rng(0)
+    B, H, L, D = 2, 4, 512, 64
+    mk = lambda: jnp.asarray(r.normal(size=(B, H, L, D)).astype(np.float32), jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+    mask = jnp.asarray((r.random(size=(B, L)) > 0.2).astype(np.int32)) if masked else None
+
+    out = flash_attention(q, k, v, mask, causal=causal, interpret=False)
+    ref = dense_reference(q, k, v, mask, causal=causal)
+    assert float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref))) < FWD_ATOL_BF16
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, mask, causal=causal, interpret=False).astype(jnp.float32) ** 2
+        )
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_reference(q, k, v, mask, causal=causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    gscale = max(float(jnp.max(jnp.abs(b.astype(jnp.float32)))) for b in gd)
+    gerr = max(
+        float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+        for a, b in zip(gf, gd)
+    )
+    assert gerr < BWD_RTOL_BF16 * max(gscale, 1.0)
