@@ -59,23 +59,38 @@ def measure(stoke, batch, api, steps=30, warmup=5):
     import jax
 
     r = np.random.default_rng(0)
-    pool = [
-        (
-            jax.device_put(r.normal(size=(batch, 32, 32, 3)).astype(np.float32)),
-            jax.device_put(r.integers(0, 10, size=(batch,))),
+    if api == "train_steps":
+        # multi-step scan: SEG optimizer steps per dispatch, stacked inputs
+        SEG = 10
+        xs = jax.device_put(
+            r.normal(size=(SEG, batch, 32, 32, 3)).astype(np.float32)
         )
-        for _ in range(4)
-    ]
+        ys = jax.device_put(r.integers(0, 10, size=(SEG, batch)))
 
-    def one_step(i):
-        x, y = pool[i % len(pool)]
-        if api == "train_step":
-            return stoke.train_step(x, (y,))
-        out = stoke.model(x)
-        loss = stoke.loss(out, y)
-        stoke.backward(loss)
-        stoke.step()
-        return loss
+        def one_step(i):
+            return stoke.train_steps(xs, (ys,))
+
+        per_call = SEG
+    else:
+        pool = [
+            (
+                jax.device_put(r.normal(size=(batch, 32, 32, 3)).astype(np.float32)),
+                jax.device_put(r.integers(0, 10, size=(batch,))),
+            )
+            for _ in range(4)
+        ]
+
+        def one_step(i):
+            x, y = pool[i % len(pool)]
+            if api == "train_step":
+                return stoke.train_step(x, (y,))
+            out = stoke.model(x)
+            loss = stoke.loss(out, y)
+            stoke.backward(loss)
+            stoke.step()
+            return loss
+
+        per_call = 1
 
     def timed(n):
         t0 = time.perf_counter()
@@ -91,14 +106,15 @@ def measure(stoke, batch, api, steps=30, warmup=5):
     t1 = timed(steps)
     t2 = timed(2 * steps)
     dt = max(t2 - t1, 1e-9)
-    return batch * steps / dt
+    return batch * steps * per_call / dt
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--batches", default="256,512,1024")
-    ap.add_argument("--apis", default="4call,train_step")
+    ap.add_argument("--apis", default="4call,train_step,train_steps")
+    ap.add_argument("--steps", type=int, default=None)
     args = ap.parse_args()
     if not args._worker:
         sys.exit(supervise(__file__, sys.argv[1:]))
@@ -106,7 +122,11 @@ def main():
     for batch in (int(b) for b in args.batches.split(",")):
         for api in args.apis.split(","):
             stoke = build(batch)
-            ips = measure(stoke, batch, api)
+            kw = {"steps": args.steps} if args.steps else {}
+            if api == "train_steps":
+                # each call is already 10 steps; fewer outer reps needed
+                kw = {"steps": max(3, (args.steps or 30) // 10), "warmup": 1}
+            ips = measure(stoke, batch, api, **kw)
             rec = {"batch": batch, "api": api, "imgs_per_sec": round(ips, 1)}
             print(json.dumps(rec), flush=True)
             results.append(rec)

@@ -768,7 +768,11 @@ class StepEngine:
             margs_stacked, mkwargs_stacked, loss_args_flat_stacked,
         )
 
-    def _build_window(self, loss_treedef, deferred_info):
+    def _window_core(self, loss_treedef, deferred_info):
+        """Unjitted whole-window core: inner ``lax.scan`` over the stacked
+        micro-batches + the fused optimizer apply.  Shared by
+        ``_build_window`` (jitted directly) and ``_build_multi`` (scanned
+        over n windows) so the two APIs cannot diverge."""
         accum = self._accum_core(loss_treedef, deferred_info, training=True)
         apply_core = self._apply_core()
 
@@ -799,6 +803,11 @@ class StepEngine:
             return (reports, new_vars, new_opt, zero_buf, new_scaler,
                     new_rng, finite)
 
+        return _window
+
+    def _build_window(self, loss_treedef, deferred_info):
+        _window = self._window_core(loss_treedef, deferred_info)
+
         if self.rules is not None:
             repl = self._repl
             out_sh = (
@@ -812,6 +821,95 @@ class StepEngine:
             )
             return jax.jit(_window, out_shardings=out_sh, donate_argnums=(0, 1, 2))
         return jax.jit(_window, donate_argnums=(0, 1, 2))
+
+    # ----------------------- multi-step scan ---------------------------- #
+
+    def multi_step(
+        self,
+        variables,
+        opt_state,
+        grad_buf,
+        scaler_state,
+        rng,
+        margs_stacked: tuple,
+        mkwargs_stacked: dict,
+        loss_args_flat_stacked: list,
+        loss_treedef,
+        deferred_info: Tuple[Tuple[int, Tuple], ...],
+    ):
+        """N COMPLETE optimizer steps in one compiled dispatch: an outer
+        ``lax.scan`` over steps, each iterating its accumulation window and
+        the fused apply.  One XLA program drives a whole training segment —
+        host dispatch (and, on remote-device links, per-dispatch round-trip
+        latency) is amortized over ``n × grad_accum`` micro-batches.  No
+        reference equivalent (the reference's hot loop is eager,
+        stoke.py:853-1040).
+
+        Stacked args carry [n_steps, grad_accum, micro_batch, ...] leaves.
+        Returns (reports [n, k, ...], variables, opt_state, grad_buf,
+        scaler_state, rng, n_nonfinite_steps).
+        """
+        key = (
+            "multi",
+            jax.tree_util.tree_structure((margs_stacked, mkwargs_stacked)),
+            loss_treedef,
+            deferred_info,
+        )
+        if key not in self._accum_cache:
+            self._accum_cache[key] = self._build_multi(loss_treedef, deferred_info)
+        return self._accum_cache[key](
+            variables, opt_state, grad_buf, scaler_state, rng,
+            margs_stacked, mkwargs_stacked, loss_args_flat_stacked,
+        )
+
+    def _build_multi(self, loss_treedef, deferred_info):
+        window = self._window_core(loss_treedef, deferred_info)
+
+        def _multi(variables, opt_state, grad_buf, scaler_state, rng,
+                   margs_s, mkwargs_s, larr_s):
+            # offloaded state → HBM ONCE, outside both scans (the cores'
+            # internal transfers are no-ops on already-device state)
+            variables = self._vars_to_compute(variables)
+            opt_state = self._opt_to_compute(opt_state)
+
+            def step_body(carry, xs):
+                variables, opt_state, buf, scaler_state, rng, skipped = carry
+                margs, mkwargs, larr = xs  # [k, ...] micro-batches
+                (reports, new_vars, new_opt, zero_buf, new_scaler, new_rng,
+                 finite) = window(
+                    variables, opt_state, buf, scaler_state, rng,
+                    margs, mkwargs, larr,
+                )
+                skipped = skipped + (1.0 - finite.astype(jnp.float32))
+                return (
+                    (new_vars, new_opt, zero_buf, new_scaler, new_rng,
+                     skipped),
+                    reports,
+                )
+
+            (vars_f, opt_f, buf_f, scaler_f, rng_f, skipped), reports = (
+                jax.lax.scan(
+                    step_body,
+                    (variables, opt_state, grad_buf, scaler_state, rng,
+                     jnp.float32(0.0)),
+                    (margs_s, mkwargs_s, larr_s),
+                )
+            )
+            return reports, vars_f, opt_f, buf_f, scaler_f, rng_f, skipped
+
+        if self.rules is not None:
+            repl = self._repl
+            out_sh = (
+                None,
+                self._var_shardings,
+                self._opt_shardings,
+                self._grad_shardings,
+                {"scale": repl, "growth_count": repl},
+                repl,  # rng
+                repl,  # skipped count
+            )
+            return jax.jit(_multi, out_shardings=out_sh, donate_argnums=(0, 1, 2))
+        return jax.jit(_multi, donate_argnums=(0, 1, 2))
 
     # ---------------------------- apply step --------------------------- #
 

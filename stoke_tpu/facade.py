@@ -88,7 +88,12 @@ class Stoke:
         params: initial model variables — either a flax variables dict
             (``{"params": ..., "batch_stats": ...}``) or a bare params pytree.
             (The reference receives an initialized ``nn.Module``; JAX splits
-            module and state, so state is passed explicitly.)
+            module and state, so state is passed explicitly.)  The facade
+            TAKES OWNERSHIP of these arrays: compiled steps donate their
+            buffers (in-place updates), and placement may alias the passed
+            tree, so do not reuse it elsewhere (e.g. to build a second
+            ``Stoke``) — read live values via ``stoke.params`` instead, or
+            pass a copy.
         batch_size_per_device: micro-batch size per device.
         grad_accum: gradient accumulation steps (reference stoke.py:137).
         grad_clip: ``ClipGradConfig`` / ``ClipGradNormConfig`` / None.
@@ -745,12 +750,21 @@ class Stoke:
             w.add_scalar(tag, float(value), step if step is not None
                          else self._optimizer_steps)
 
-    def _maybe_log_metrics(self) -> None:
+    @staticmethod
+    def _crossed_boundary(steps: int, every: int, window: int) -> bool:
+        """True if any multiple of ``every`` falls in ``(steps-window,
+        steps]`` — the cadence check for step paths that advance the counter
+        by more than one (train_steps segments)."""
+        return steps > 0 and steps // every > (steps - window) // every
+
+    def _maybe_log_metrics(self, window: int = 1) -> None:
         cfg = self._status_obj.tensorboard_config
         if (
             cfg is None
             or self._optimizer_steps == 0
-            or self._optimizer_steps % cfg.log_every_n_steps != 0
+            or not self._crossed_boundary(
+                self._optimizer_steps, cfg.log_every_n_steps, window
+            )
         ):
             return
         w = self._tb_writer
@@ -766,16 +780,19 @@ class Stoke:
         w.add_scalar("counters/backward_steps", self._backward_steps, step)
         w.flush()
 
-    def _maybe_auto_save(self) -> None:
+    def _maybe_auto_save(self, window: int = 1) -> None:
         """Periodic checkpoint from the step path when
         ``CheckpointConfig.save_every_n_steps`` is set — the crash-recovery
-        half of checkpoint-restart (SURVEY.md §5: the reference has none)."""
+        half of checkpoint-restart (SURVEY.md §5: the reference has none).
+        ``window``: how many optimizer steps the caller just advanced (a
+        train_steps segment may cross a save boundary mid-segment)."""
         cfg = self._status_obj.checkpoint_config
         if (
             cfg.save_every_n_steps
             and cfg.auto_path
-            and self._optimizer_steps > 0
-            and self._optimizer_steps % cfg.save_every_n_steps == 0
+            and self._crossed_boundary(
+                self._optimizer_steps, cfg.save_every_n_steps, window
+            )
         ):
             self.save(cfg.auto_path, name=cfg.auto_name)
 
@@ -888,6 +905,129 @@ class Stoke:
         self._reset_tracking_window()
         self._maybe_log_metrics()
         self._maybe_auto_save()
+        return reports
+
+    @_timed("train_steps")
+    def train_steps(
+        self,
+        model_args: Any,
+        loss_args: Any = (),
+        model_kwargs: Optional[dict] = None,
+    ):
+        """N complete optimizer steps in ONE compiled dispatch (outer
+        ``lax.scan`` over steps, inner scan over each accumulation window,
+        fused apply per step).
+
+        The TPU-idiomatic answer to dispatch-bound loops: a whole training
+        segment is one XLA program, so host dispatch overhead (and, through
+        remote-device links, per-dispatch round-trip latency) is amortized
+        over ``n x grad_accum`` micro-batches.
+
+        Args are stacked micro-batches: each array leaf has shape
+        ``[total_micro, micro_batch, ...]`` where ``total_micro`` is a
+        multiple of ``grad_accum``; ``n = total_micro // grad_accum``
+        optimizer steps run.  Must be called at a window boundary.  Returns
+        per-micro loss reports stacked to ``[n, grad_accum, ...]``.
+
+        Loss tracking: the EMA advances once per optimizer step with that
+        step's window-mean loss (same semantics as ``n`` calls to
+        ``train_step_window``).  Auto-save and metric logging fire at the end
+        of the segment whenever their step cadence was crossed anywhere
+        inside it (a save_every_n_steps boundary mid-segment is honored, just
+        deferred to the segment end).
+        """
+        if not self._training:
+            raise RuntimeError("Stoke -- train_steps() called in eval mode")
+        if self._grad_accum_counter != 0:
+            raise RuntimeError(
+                "Stoke -- train_steps() must start at an accumulation "
+                f"boundary (counter={self._grad_accum_counter}); finish the "
+                "window with backward()/step() or reset() first"
+            )
+        k = self._status_obj.grad_accum
+        if not isinstance(model_args, tuple):
+            model_args = (model_args,)
+        if not isinstance(loss_args, tuple):
+            loss_args = (loss_args,)
+        n = None
+        for leaf in jax.tree_util.tree_leaves(
+            (model_args, loss_args, model_kwargs or {})
+        ):
+            if hasattr(leaf, "shape") and leaf.shape:
+                if leaf.shape[0] % k:
+                    raise ValueError(
+                        f"Stoke -- train_steps() leaves must stack "
+                        f"[total_micro, micro_batch, ...] with total_micro a "
+                        f"multiple of grad_accum={k}; got {leaf.shape}"
+                    )
+                if n is None:
+                    n = leaf.shape[0] // k
+                elif leaf.shape[0] // k != n:
+                    raise ValueError(
+                        "Stoke -- train_steps() leaves disagree on the "
+                        "number of stacked micro-batches"
+                    )
+        if not n:
+            raise ValueError(
+                "Stoke -- train_steps() found no stacked array leaves"
+            )
+
+        def _fold(t):
+            return jax.tree_util.tree_map(
+                lambda l: l.reshape((n, k) + tuple(l.shape[1:]))
+                if hasattr(l, "shape") and l.shape
+                else l,
+                t,
+            )
+
+        margs = self._place_batch(_fold(model_args), batch_dim=2)
+        mkwargs = self._place_batch(_fold(model_kwargs or {}), batch_dim=2)
+        sentinel = DeferredOutput(None, -1)
+        flat, treedef = jax.tree_util.tree_flatten(
+            ((sentinel, *loss_args), {}), is_leaf=is_deferred
+        )
+        arrays = self._place_batch(
+            _fold([l for l in flat if not is_deferred(l)]), batch_dim=2
+        )
+        deferred_info = tuple(
+            (i, l._path) for i, l in enumerate(flat) if is_deferred(l)
+        )
+        (
+            reports,
+            self._variables,
+            new_opt,
+            self._grad_buf,
+            self._scaler_state,
+            self._rng,
+            skipped,
+        ) = self._engine.multi_step(
+            self._variables,
+            self._opt_materialize(),
+            self._grad_buf,
+            self._scaler_state,
+            self._rng,
+            margs,
+            mkwargs,
+            arrays,
+            treedef,
+            deferred_info,
+        )
+        self._opt_commit(new_opt)
+        self._pending = None
+        self._backward_steps += n * k
+        # EMA per optimizer step from the stacked reports (host-side slices
+        # of device scalars — no extra dispatches)
+        for i in range(n):
+            step_mean = jax.tree_util.tree_map(
+                lambda r: r[i].mean(axis=0), reports
+            )
+            self._update_loss_tracking(step_mean)
+            self._reset_tracking_window()
+        if self._precision.scaled:
+            self._skipped_steps = self._skipped_steps + skipped
+        self._optimizer_steps += n
+        self._maybe_log_metrics(window=n)
+        self._maybe_auto_save(window=n)
         return reports
 
     def reset(self) -> None:
