@@ -1,0 +1,25 @@
+# Hermetic entry points. The ambient PYTHONPATH loads the axon
+# sitecustomize, which dials the single-client remote-TPU relay at EVERY
+# interpreter start — a stray CPU-side run while a measurement holds the
+# tunnel wedges it (BENCH_NOTES.md incident log). These targets pin the
+# environment so CPU work can never touch the chip.
+
+CPU_ENV = env PYTHONPATH=$(CURDIR) JAX_PLATFORMS=cpu
+MESH_ENV = $(CPU_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: test test-fast dryrun bench-smoke tpu-probe
+
+test:            ## full suite on the simulated 8-device CPU mesh
+	$(MESH_ENV) python -m pytest tests/ -x -q
+
+test-fast:       ## quick subset (status/facade/data), CPU mesh
+	$(MESH_ENV) python -m pytest tests/test_status.py tests/test_facade.py tests/test_data.py -x -q
+
+dryrun:          ## multi-chip sharding dry-run on 8 virtual devices
+	$(MESH_ENV) python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
+
+bench-smoke:     ## CPU-safe bench smoke (never touches the tunnel)
+	$(CPU_ENV) python bench.py --preset tiny
+
+tpu-probe:       ## 60s health probe of the real chip (tunnel-safe timeout)
+	timeout 60 python -c "import jax; print(jax.devices())"

@@ -1,0 +1,112 @@
+"""Utilization anatomy for the CIFAR-10 ResNet-50 bench (gap analysis).
+
+Measures, on one chip:
+  1. bf16 matmul peak (8k^3) — the realistic MXU ceiling on this part
+  2. ResNet-50 fwd-only (eval) step time
+  3. full train_steps segment time (the bench path)
+  4. XLA cost-model FLOPs of one fused optimizer step (facade
+     estimate_step_flops)
+and prints achieved TFLOP/s + fraction of measured peak per phase.
+
+The point: if (3) tracks (4)/(1) closely and the 4call/train_step/
+train_steps spread is small, the gap to the A100 constant is conv-shape
+utilization (32x32 images, narrow channels), not framework overhead.
+
+Run serialized on the TPU (supervised; tunnel is single-client).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _supervise import supervise  # noqa: E402
+
+
+def main():
+    if "--_worker" not in sys.argv:
+        sys.exit(supervise(__file__, [a for a in sys.argv[1:] if a != "--_worker"]))
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from stoke_tpu import Stoke, StokeOptimizer
+    from stoke_tpu.models import ResNet50
+    from stoke_tpu.utils import init_module
+
+    from _timing import delta_time
+
+    r = np.random.default_rng(0)
+
+    # 1. matmul peak
+    N = 8192
+    a = jax.device_put(jnp.asarray(r.normal(size=(N, N)).astype(np.float32),
+                                   jnp.bfloat16))
+    b = jax.device_put(jnp.asarray(r.normal(size=(N, N)).astype(np.float32),
+                                   jnp.bfloat16))
+    mm = jax.jit(lambda: (a @ b))
+    t_mm = delta_time(mm, 10)
+    peak_tflops = 2 * N**3 / t_mm / 1e12
+    print(json.dumps({"probe": "matmul_peak", "n": N,
+                      "ms": round(t_mm * 1e3, 3),
+                      "tflops": round(peak_tflops, 1)}), flush=True)
+
+    # 2-4. ResNet-50 through the facade
+    batch, SEG = 256, 10
+    model = ResNet50(num_classes=10, cifar_stem=True)
+    variables = init_module(
+        model, jax.random.PRNGKey(0), np.zeros((2, 32, 32, 3), np.float32),
+        train=False,
+    )
+    stoke = Stoke(
+        model=model,
+        optimizer=StokeOptimizer(
+            optimizer=optax.sgd,
+            optimizer_kwargs={"learning_rate": 0.05, "momentum": 0.9},
+        ),
+        loss=lambda lo, la: optax.softmax_cross_entropy_with_integer_labels(
+            lo, la).mean(),
+        params=variables,
+        batch_size_per_device=batch,
+        device="tpu" if jax.default_backend() != "cpu" else "cpu",
+        precision="bf16",
+        model_train_kwargs={"train": True},
+        model_eval_kwargs={"train": False},
+        verbose=False,
+    )
+    x1 = jax.device_put(r.normal(size=(batch, 32, 32, 3)).astype(np.float32))
+    y1 = jax.device_put(r.integers(0, 10, size=(batch,)))
+
+    step_flops = stoke.estimate_step_flops(x1, (y1,))
+    print(json.dumps({"probe": "cost_analysis",
+                      "gflops_per_step": None if step_flops is None
+                      else round(step_flops / 1e9, 1)}), flush=True)
+
+    stoke.eval()
+    t_fwd = delta_time(lambda: stoke.model(x1), 20)
+    stoke.train()
+    print(json.dumps({"probe": "fwd_only", "ms": round(t_fwd * 1e3, 3),
+                      "imgs_per_sec": round(batch / t_fwd, 1)}), flush=True)
+
+    xs = jax.device_put(r.normal(size=(SEG, batch, 32, 32, 3)).astype(np.float32))
+    ys = jax.device_put(r.integers(0, 10, size=(SEG, batch)))
+    t_seg = delta_time(lambda: stoke.train_steps(xs, (ys,)), 3)
+    step_ms = t_seg / SEG * 1e3
+    ips = batch * SEG / t_seg
+    rec = {"probe": "train_steps", "step_ms": round(step_ms, 3),
+           "imgs_per_sec": round(ips, 1)}
+    if step_flops:
+        ach = step_flops / (t_seg / SEG) / 1e12
+        rec["achieved_tflops"] = round(ach, 2)
+        rec["fraction_of_matmul_peak"] = round(ach / peak_tflops, 4)
+    print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()
