@@ -99,7 +99,7 @@ def main():
     if args.attention == "flash":
         from stoke_tpu.ops import make_flash_attention
 
-        attention_fn = make_flash_attention(block_q=32, block_k=32)
+        attention_fn = make_flash_attention()  # auto block sizing (512-pref ladder)
     elif args.attention in ("ring", "ulysses"):
         from stoke_tpu.configs import DeviceOptions, MeshConfig
         from stoke_tpu.ops import make_ring_attention, make_ulysses_attention

@@ -66,7 +66,7 @@ def main():
     if args.attention == "flash":
         from stoke_tpu.ops import make_flash_attention
 
-        attention_fn = make_flash_attention(causal=True, block_q=64, block_k=64)
+        attention_fn = make_flash_attention(causal=True)  # auto block sizing
         is_causal = True
     elif args.attention == "ring":
         from stoke_tpu.configs import DeviceOptions, MeshConfig

@@ -91,23 +91,38 @@ def evaluate(stoke, x, y, batch=128):
     return correct / max(n, 1)
 
 
-def run_digits(model_name, epochs):
+def run_digits(model_name, epochs, augment=False):
     (xt, yt), (xv, yv) = load_digits_32()
     batch = 128
     spe = len(xt) // batch
     stoke = build(model_name, 10, 0.02, spe, epochs)
     rng = np.random.default_rng(1)
+
+    def shift_batch(xb):
+        """Random ±3px 2D shifts (pad+crop), host-side: 1500 train samples
+        overfit badly without it; digits must not be flipped/rotated."""
+        pad = np.pad(xb, ((0, 0), (3, 3), (3, 3), (0, 0)), mode="constant")
+        out = np.empty_like(xb)
+        offs = rng.integers(0, 7, size=(len(xb), 2))
+        for j, (dy, dx) in enumerate(offs):
+            out[j] = pad[j, dy : dy + 32, dx : dx + 32]
+        return out
+
     t0 = time.time()
     for ep in range(epochs):
         order = rng.permutation(len(xt))
         for i in range(spe):
             idx = order[i * batch : (i + 1) * batch]
-            stoke.train_step(xt[idx], (yt[idx],))
+            xb = xt[idx]
+            if augment:
+                xb = shift_batch(xb)
+            stoke.train_step(xb, (yt[idx],))
     stoke.block_until_ready()
     wall = time.time() - t0
     acc = evaluate(stoke, xv, yv)
     print(json.dumps({
         "phase": "digits_real_data", "model": model_name, "epochs": epochs,
+        "augment": augment,
         "train_n": len(xt), "test_n": len(xv),
         "top1": round(acc, 4), "wall_s": round(wall, 1),
         "ema_loss": round(float(stoke.ema_loss), 4),
@@ -149,13 +164,15 @@ if __name__ == "__main__":
                     choices=["resnet18", "resnet50"])
     ap.add_argument("--epochs", type=int, default=30)
     ap.add_argument("--skip-overfit", action="store_true")
+    ap.add_argument("--augment", action="store_true",
+                    help="random-shift augmentation for the digits phase")
     ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
     if not args._worker:
         from _supervise import supervise
 
         sys.exit(supervise(__file__, sys.argv[1:]))
-    acc = run_digits(args.model, args.epochs)
+    acc = run_digits(args.model, args.epochs, augment=args.augment)
     ok = acc >= 0.95
     if not args.skip_overfit:
         oacc = run_synthetic_overfit(args.model)
