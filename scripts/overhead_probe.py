@@ -25,7 +25,7 @@ from _supervise import supervise  # noqa: E402
 
 def main():
     if "--_worker" not in sys.argv:
-        sys.exit(supervise(__file__, [a for a in sys.argv[1:] if a != "--_worker"]))
+        sys.exit(supervise(__file__, sys.argv[1:]))
 
     import argparse
 
