@@ -1,0 +1,72 @@
+"""Run the whole remaining TPU measurement queue in ONE process.
+
+The tunnel lore (BENCH_NOTES.md) is that repeated backend bring-up/teardown
+is what wedges the relay — so instead of four supervised processes, this
+session runs each measurement script's worker main sequentially inside one
+interpreter: one probe, one backend bring-up, one long watchdog.
+
+    python scripts/tpu_session.py            # default queue
+    python scripts/tpu_session.py --only flops_probe,bench
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import runpy
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+sys.path.insert(0, HERE)
+
+from _supervise import supervise  # noqa: E402
+
+#: name -> (path relative to repo root, worker argv)
+QUEUE = {
+    "flops_probe": ("scripts/flops_probe.py", []),
+    "accuracy": ("scripts/accuracy_run.py",
+                 ["--model", "resnet18", "--epochs", "120", "--augment",
+                  "--skip-overfit"]),
+    "longcontext": ("scripts/bench_longcontext.py", []),
+    "bench": ("bench.py", []),
+    # CPU-safe smoke of the runpy dispatch itself (not part of the default
+    # queue): tiny preset, finishes in ~1 min off-chip
+    "smoke": ("bench.py", ["--preset", "tiny"]),
+}
+DEFAULT_QUEUE = ("flops_probe", "accuracy", "longcontext", "bench")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--only", default=",".join(DEFAULT_QUEUE),
+                    help="comma-separated subset of: " + ", ".join(QUEUE))
+    args = ap.parse_args()
+    if not args._worker:
+        sys.exit(supervise(__file__, sys.argv[1:], watchdog_seconds=5400))
+
+    root = os.path.dirname(HERE)
+    failures = 0
+    for name in args.only.split(","):
+        script, argv = QUEUE[name]
+        path = os.path.join(root, script)
+        print(json.dumps({"session": name, "script": script}), flush=True)
+        sys.argv = [path, "--_worker", *argv]
+        try:
+            runpy.run_path(path, run_name="__main__")
+        except SystemExit as e:
+            if e.code not in (0, None):
+                failures += 1
+                print(json.dumps({"session": name, "exit": e.code}), flush=True)
+        except Exception as e:
+            failures += 1
+            print(json.dumps({"session": name,
+                              "error": f"{type(e).__name__}: {e}"[:200]}),
+                  flush=True)
+    print(json.dumps({"session": "done", "failures": failures}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
