@@ -54,7 +54,12 @@ def _pick_block(requested: Optional[int], L: int, default: int) -> int:
     for c in _BLOCK_CANDIDATES:
         if c <= default and L % c == 0:
             return c
-    return min(default, L)
+    raise ValueError(
+        f"flash attention auto block selection: no candidate in "
+        f"{_BLOCK_CANDIDATES} divides sequence length {L}. Pad the sequence "
+        f"to a multiple of one of the candidates (e.g. {64 * -(-L // 64)}), "
+        f"or pass an explicit block size that divides L."
+    )
 
 
 # --------------------------------------------------------------------------- #
