@@ -63,14 +63,26 @@ def _persist_result(metric: str, record: dict) -> None:
     os.replace(tmp, RESULTS_PATH)
 
 
-def _emit_persisted(metric: str, capture_error: str) -> int:
+def _emit_persisted(metric: str, capture_error: str,
+                    requested: dict | None = None) -> int:
     """Emit the last verified on-chip measurement as the official value.
 
     Returns the process exit code: 0 when a persisted measurement exists
     (the record is real, only the capture is stale), 1 only when the metric
-    has never been successfully measured.
+    has never been successfully measured.  ``requested`` carries the run's
+    explicit --api/--batch selections: a persisted record measured under a
+    DIFFERENT configuration is never substituted for it.
     """
     rec = _load_results().get(metric)
+    if rec and requested:
+        for key, want in requested.items():
+            if want is not None and rec.get(key) != want:
+                capture_error += (
+                    f" [persisted record not applicable: measured with "
+                    f"{key}={rec.get(key)!r}, run requested {key}={want!r}]"
+                )
+                rec = None
+                break
     if rec and rec.get("value", 0) > 0:
         out = {
             "metric": metric,
@@ -140,7 +152,7 @@ def _probe_devices() -> str | None:
     return last
 
 
-def _supervise(argv, preset: str) -> int:
+def _supervise(argv, preset: str, requested: dict | None = None) -> int:
     """Run the real bench in a subprocess with a watchdog.
 
     A wedged tunnel hangs *any* process at jax import, so this wrapper never
@@ -156,10 +168,12 @@ def _supervise(argv, preset: str) -> int:
         # don't burn the watchdog on a CPU ResNet-50 run whose result the
         # on_accelerator check would discard anyway
         return _emit_persisted(
-            run_metric, "device probe found CPU-only backend (no TPU visible)"
+            run_metric,
+            "device probe found CPU-only backend (no TPU visible)",
+            requested,
         )
     if err is not None and err != _CPU_ONLY:
-        return _emit_persisted(run_metric, err)
+        return _emit_persisted(run_metric, err, requested)
     try:
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--_worker", *argv],
@@ -197,6 +211,7 @@ def _supervise(argv, preset: str) -> int:
                     return _emit_persisted(
                         parsed["metric"],
                         "bench ran on CPU backend (no accelerator visible)",
+                        requested,
                     )
                 print(line)
                 return 0
@@ -204,7 +219,7 @@ def _supervise(argv, preset: str) -> int:
         detail = err_lines[-1][:200] if err_lines else "unknown"
     except subprocess.TimeoutExpired:
         detail = f"timeout after {WATCHDOG_SECONDS}s (TPU tunnel wedged?)"
-    return _emit_persisted(run_metric, detail)
+    return _emit_persisted(run_metric, detail, requested)
 
 
 def main():
@@ -222,7 +237,10 @@ def main():
     ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
     if not args._worker:
-        sys.exit(_supervise(sys.argv[1:], args.preset))
+        sys.exit(_supervise(
+            sys.argv[1:], args.preset,
+            requested={"api": args.api, "batch": args.batch},
+        ))
 
     import numpy as np
 

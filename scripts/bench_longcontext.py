@@ -61,12 +61,76 @@ def build(size, L, batch, attention):
     )
 
 
+def bench_ring_inner(lengths, batch, heads, head_dim):
+    """Op-level arm: ring attention with dense vs flash inner math, fwd+bwd,
+    over a ("data", "seq") mesh spanning every visible device.  On a single
+    chip the ring degenerates to one hop — which is precisely the comparison
+    that matters there: the dense inner materializes the [L, L] score block
+    and falls off the OOM cliff at L≥8k while the flash inner keeps running.
+    On the simulated 8-device CPU mesh the same code exercises the full
+    multi-hop composition (per-hop flash + lse merge)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from stoke_tpu.ops import ring_attention
+
+    from _timing import delta_time
+
+    devs = np.asarray(jax.devices()).reshape(1, -1)
+    mesh = Mesh(devs, ("data", "seq"))
+    n = devs.size
+    r = np.random.default_rng(0)
+    results = []
+    for L in lengths:
+        mk = lambda: jnp.asarray(
+            r.normal(size=(batch, heads, L, head_dim)).astype(np.float32),
+            jnp.bfloat16,
+        )
+        q, k, v = mk(), mk(), mk()
+        for inner in ("dense", "flash"):
+            try:
+                def loss(q, k, v):
+                    out = ring_attention(
+                        q, k, v, mesh=mesh, axis_name="seq", causal=True,
+                        inner=inner,
+                    )
+                    return jnp.sum(out.astype(jnp.float32) ** 2)
+
+                step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+                step(q, k, v)  # compile
+                t = delta_time(lambda: step(q, k, v), 5)
+                rec = {"bench": "ring_inner", "L": L, "batch": batch,
+                       "heads": heads, "head_dim": head_dim, "devices": n,
+                       "inner": inner, "fwdbwd_ms": round(t * 1e3, 2)}
+            except Exception as e:
+                rec = {"bench": "ring_inner", "L": L, "batch": batch,
+                       "heads": heads, "head_dim": head_dim, "devices": n,
+                       "inner": inner, "error": type(e).__name__}
+            print(json.dumps(rec), flush=True)
+            results.append(rec)
+    ok = [p for p in results if "error" not in p]
+    for L in sorted({p["L"] for p in ok}):
+        d = next((p for p in ok if p["L"] == L and p["inner"] == "dense"), None)
+        f = next((p for p in ok if p["L"] == L and p["inner"] == "flash"), None)
+        if d and f:
+            print(json.dumps({"bench": "ring_inner", "L": L,
+                              "flash_inner_speedup": round(
+                                  d["fwdbwd_ms"] / f["fwdbwd_ms"], 2)}),
+                  flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--size", default="mini")
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--lengths", default="1024,4096,8192")
+    ap.add_argument("--op-ring", action="store_true",
+                    help="op-level ring-inner arm (dense vs flash hop math) "
+                    "instead of the model-level GPT sweep")
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--head-dim", type=int, default=64)
     args = ap.parse_args()
     if not args._worker:
         sys.exit(supervise(__file__, sys.argv[1:], watchdog_seconds=3000))
@@ -74,6 +138,13 @@ def main():
     import jax
 
     from _timing import delta_time
+
+    if args.op_ring:
+        bench_ring_inner(
+            [int(x) for x in args.lengths.split(",")],
+            args.batch, args.heads, args.head_dim,
+        )
+        return
 
     r = np.random.default_rng(0)
     results = []

@@ -382,10 +382,12 @@ class StepEngine:
         offload_optimizer: Optional[Any] = None,
         offload_params: Optional[Any] = None,
         loss_weights: Optional[Any] = None,
+        aux_loss_weight: float = 0.01,
     ):
         self.adapter = adapter
         self.loss_fn = loss_fn
         self.loss_weights = loss_weights
+        self.aux_loss_weight = float(aux_loss_weight)
         self.optimizer = optimizer
         self.precision = precision
         self.precision_config = precision_config
@@ -686,6 +688,18 @@ class StepEngine:
                     total = sum(
                         jnp.asarray(l, jnp.float32).sum() for l in leaves
                     )
+                # model-internal auxiliary losses (e.g. the MoE router's
+                # load-balancing term) arrive sown into the "losses"
+                # collection (models/moe.py); they join the objective with
+                # the configured weight but are NOT part of the user's loss
+                # report (observable via the facade's state instead)
+                if self.aux_loss_weight and "losses" in updated:
+                    aux_leaves = jax.tree_util.tree_leaves(updated["losses"])
+                    if aux_leaves:
+                        total = total + jnp.float32(self.aux_loss_weight) * sum(
+                            jnp.asarray(a, jnp.float32).sum()
+                            for a in aux_leaves
+                        )
                 # reference divides the training loss by grad_accum at loss()
                 # time (stoke.py:901-911); fp16 additionally scales for the
                 # dynamic scaler.  Reported per-loss values stay UNweighted.

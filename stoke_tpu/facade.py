@@ -113,6 +113,12 @@ class Stoke:
             stoke.py:891-902); reported per-loss values stay unweighted.
             ``None`` (default) sums all losses with weight 1 — the
             "summed objective" contract.
+        aux_loss_weight: weight for MODEL-internal auxiliary losses sown
+            into the flax "losses" collection (e.g. the MoE router's
+            load-balancing term, models/moe.py) — they join the training
+            objective as ``aux_loss_weight · Σ aux`` (0 disables; default
+            0.01, the Switch-Transformer α).  The user's loss report stays
+            untouched; latest values are readable via ``aux_losses``.
         seed: PRNG seed for dropout etc.
         ema_weight: EMA coefficient for the rolling loss (reference
             stoke.py:155 ``ema_weight``).
@@ -139,6 +145,7 @@ class Stoke:
         model_eval_kwargs: Optional[dict] = None,
         model_rng_keys: Sequence[str] = ("dropout",),
         loss_weights: Optional[Any] = None,
+        aux_loss_weight: float = 0.01,
         seed: int = 0,
         ema_weight: float = 0.1,
         verbose: bool = True,
@@ -219,6 +226,7 @@ class Stoke:
             offload_optimizer=st.offload_optimizer_config,
             offload_params=st.offload_params_config,
             loss_weights=loss_weights,
+            aux_loss_weight=aux_loss_weight,
         )
         if self._rules is not None:
             opt_shapes = jax.eval_shape(self._optimizer.init, variables["params"])
@@ -1446,6 +1454,15 @@ class Stoke:
     @property
     def params(self) -> Any:
         return self._variables["params"]
+
+    @property
+    def aux_losses(self) -> Optional[Any]:
+        """Latest model-internal auxiliary losses (the flax "losses"
+        collection, e.g. MoE load-balancing terms) as of the last training
+        step — ``None`` for models that sow none.  These feed the objective
+        weighted by ``aux_loss_weight``; they are not part of ``loss()``'s
+        report."""
+        return self._variables.get("losses")
 
     @property
     def opt_state(self) -> Any:

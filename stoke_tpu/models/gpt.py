@@ -56,6 +56,7 @@ class GPT(nn.Module):
     moe_every: int = 2
     moe_capacity_factor: float = 1.25
     moe_router_noise: float = 0.0  # needs the "router" rng stream when > 0
+    moe_top_k: int = 1  # experts per token (1 = Switch, 2 = GShard-style)
 
     @nn.compact
     def __call__(self, input_ids, train: bool = True):
@@ -106,7 +107,7 @@ class GPT(nn.Module):
                     size.hidden, size.heads, size.ff, self.moe_num_experts,
                     self.dropout_rate, self.moe_capacity_factor,
                     self.attention_fn, self.moe_router_noise,
-                    name=f"layer_{i}",
+                    self.moe_top_k, name=f"layer_{i}",
                 )(h, bias, not train)
             else:
                 h = block(

@@ -8,11 +8,20 @@ dimension over a mesh ``expert`` axis with a partition rule
 (:func:`moe_expert_parallel_rules`) and GSPMD lowers the dispatch/combine
 einsums to the all-to-all pattern — no hand-written collectives.
 
-Routing is standard switch-style top-1 with a capacity limit: each token
-goes to its argmax expert; experts accept at most
-``ceil(tokens/E) * capacity_factor`` tokens; overflow tokens pass through
-the residual unchanged (combine weight 0).  Dispatch/combine are one-hot
-einsums (MXU-friendly, static shapes — no gather/scatter).
+Routing is switch-style top-k (k=1 default; k=2 gives GShard-style routing
+with renormalized gates) with a capacity limit: experts accept at most
+``ceil(tokens/E) * capacity_factor`` tokens per choice-priority order
+(first choices fill capacity before second choices); overflow tokens pass
+through the residual unchanged (combine weight 0).  Dispatch/combine are
+one-hot einsums (MXU-friendly, static shapes — no gather/scatter).
+
+**Load balancing**: the router computes the Switch-Transformer auxiliary
+loss ``aux = E · Σ_e f_e · P_e`` (f_e = fraction of tokens whose first
+choice is expert e, P_e = mean router probability of e; minimized at 1.0
+by the uniform assignment) and sows it into the flax ``"losses"``
+collection.  The training engine adds sown losses to the objective with
+the facade's ``aux_loss_weight`` (default 0.01) — without this term,
+top-1 routing collapses onto a few experts in real training.
 """
 
 from __future__ import annotations
@@ -36,6 +45,8 @@ class MoEFFN(nn.Module):
         capacity_factor: per-expert capacity = ceil(N/E) * factor.
         router_noise: train-time logit jitter (load balancing aid); needs the
             ``router`` rng stream when > 0.
+        top_k: experts per token (1 = Switch, 2 = GShard-style with
+            renormalized gates).
     """
 
     hidden: int
@@ -43,6 +54,7 @@ class MoEFFN(nn.Module):
     num_experts: int = 8
     capacity_factor: float = 1.25
     router_noise: float = 0.0
+    top_k: int = 1
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -53,6 +65,9 @@ class MoEFFN(nn.Module):
         # real sequence lengths).
         G, S, H = x.shape
         E = self.num_experts
+        k = self.top_k
+        if not 1 <= k <= E:
+            raise ValueError(f"MoEFFN: top_k must be in [1, {E}], got {k}")
         C = max(1, int(np.ceil(S / E) * self.capacity_factor))
 
         logits = nn.Dense(E, use_bias=False, name="router")(x)  # [G, S, E]
@@ -62,24 +77,49 @@ class MoEFFN(nn.Module):
                 key, logits.shape, logits.dtype
             )
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        expert_idx = jnp.argmax(probs, axis=-1)  # [G, S]
-        gate = jnp.take_along_axis(probs, expert_idx[..., None], axis=-1)[..., 0]
+        topk_probs, topk_idx = jax.lax.top_k(probs, k)  # [G, S, k]
+        if k > 1:
+            gates = topk_probs / jnp.maximum(
+                jnp.sum(topk_probs, axis=-1, keepdims=True), 1e-9
+            )
+        else:
+            gates = topk_probs
 
-        # capacity: position of each token within its expert's per-group queue
-        assign = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [G, S, E]
-        position = (jnp.cumsum(assign, axis=1) - 1.0) * assign
-        pos_in_expert = jnp.sum(position, axis=-1)  # [G, S]
-        keep = pos_in_expert < C
-        gate = gate * keep
+        # Switch load-balancing loss: E · Σ_e f_e·P_e (f from first choices,
+        # P the mean router prob; ≥ 1 with equality at uniform).  Sown with
+        # an overwriting reduce_fn so the collection stays a stable scalar
+        # across steps (the engine folds it into the objective and the
+        # facade surfaces it via ``aux_losses``).
+        assign1 = jax.nn.one_hot(topk_idx[..., 0], E, dtype=jnp.float32)
+        f_e = jnp.mean(assign1, axis=(0, 1))           # [E]
+        p_e = jnp.mean(probs, axis=(0, 1))             # [E]
+        aux = jnp.float32(E) * jnp.sum(f_e * p_e)
+        self.sow(
+            "losses", "aux_loss", aux,
+            reduce_fn=lambda prev, new: new,
+            init_fn=lambda: jnp.float32(0.0),
+        )
+
+        # capacity: queue position per (token, choice), choice-major priority
+        # (all first choices claim capacity before any second choice)
+        assign_k = jax.nn.one_hot(topk_idx, E, dtype=jnp.float32)  # [G,S,k,E]
+        prio = assign_k.transpose(0, 2, 1, 3).reshape(G, k * S, E)
+        position = (jnp.cumsum(prio, axis=1) - 1.0) * prio
+        pos_tok = jnp.sum(position, axis=-1)           # [G, k*S]
+        pos_tok = pos_tok.reshape(G, k, S).transpose(0, 2, 1)  # [G, S, k]
+        keep = pos_tok < C
+        gates = gates * keep
 
         # dispatch/combine: [G, S, E, C] one-hot (static shapes, MXU)
         pos_oh = jax.nn.one_hot(
-            pos_in_expert.astype(jnp.int32), C, dtype=jnp.float32
+            pos_tok.astype(jnp.int32), C, dtype=jnp.float32
+        )  # [G, S, k, C]
+        dispatch = jnp.einsum(
+            "gsje,gsjc->gsec", assign_k * keep[..., None], pos_oh
         )
-        dispatch = (
-            assign[..., None] * pos_oh[:, :, None, :] * keep[..., None, None]
+        combine = jnp.einsum(
+            "gsje,gsjc->gsec", assign_k * gates[..., None], pos_oh
         )
-        combine = dispatch * gate[..., None, None]
 
         # route → expert MLPs (weights stacked on the expert dim) → return
         expert_in = jnp.einsum(
@@ -122,6 +162,7 @@ class MoETransformerBlock(nn.Module):
     capacity_factor: float = 1.25
     attention_fn: Optional[Callable] = None
     router_noise: float = 0.0
+    top_k: int = 1
 
     @nn.compact
     def __call__(self, x, bias, deterministic: bool):
@@ -135,7 +176,7 @@ class MoETransformerBlock(nn.Module):
         x = nn.LayerNorm(epsilon=1e-12, name="ln_attn")(x + y)
         y = MoEFFN(
             self.hidden, self.ff, self.num_experts, self.capacity_factor,
-            self.router_noise, name="moe",
+            self.router_noise, self.top_k, name="moe",
         )(x, train=not deterministic)
         y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
         return nn.LayerNorm(epsilon=1e-12, name="ln_ff")(x + y)

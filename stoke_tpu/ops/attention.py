@@ -21,6 +21,18 @@ scales with the number of devices; both are drop-in ``attention_fn``s for
 Both are written against ``shard_map`` (explicit per-shard code + explicit
 collectives) and compose with the jit-GSPMD data-parallel engine: the mesh
 carries ("data", "seq") axes and batch arrays are sharded over both.
+
+**Inner kernel** (``inner=`` on every entry point): ``"flash"`` runs the
+on-chip math through the Pallas flash kernel (``ops/flash_attention.py``) —
+ring hops call flash with ``return_lse`` and merge partial attentions with
+a log-sum-exp combine (per-device attention memory O(L·D·H/n), no score
+materialization, vs the dense inner's O((L/n)²·H) score blocks); Ulysses
+runs one flash call over the gathered sequence after the all-to-all, so
+local memory is O(L·D·H/n) not O(L²·H/n).  ``"dense"`` keeps the einsum
+inner math (useful for debugging and as the numerics reference).  The
+default ``"auto"`` picks flash whenever the local length fits the flash
+block ladder (L ≤ 512 or divisible by a candidate) and dense otherwise, so
+pre-existing call sites keep working for any L.
 """
 
 from __future__ import annotations
@@ -32,6 +44,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+
+from .flash_attention import _pick_block, flash_attention
 
 try:  # jax >= 0.4.35 exposes shard_map at top level
     from jax import shard_map as _shard_map_fn
@@ -51,6 +65,25 @@ except ImportError:  # pragma: no cover - older jax
         )
 
 _NEG_INF = -1e30
+
+
+def _resolve_inner(inner: str, L: int) -> str:
+    """Resolve the inner-kernel choice.  ``"auto"`` (the default) uses flash
+    when the flash block picker supports the local length L and falls back
+    to the dense einsum otherwise (flash needs L ≤ 512 or L divisible by a
+    block candidate); explicit ``"flash"``/``"dense"`` are honored verbatim
+    (flash will raise its actionable block error for unsupported L)."""
+    if inner not in ("auto", "flash", "dense"):
+        raise ValueError(
+            f"inner must be 'auto', 'flash' or 'dense', got {inner!r}"
+        )
+    if inner != "auto":
+        return inner
+    try:
+        _pick_block(None, L, 512)
+        return "flash"
+    except ValueError:
+        return "dense"
 
 
 def _resolve_batch_axis(q, mesh, axis_name, batch_axis) -> Optional[str]:
@@ -131,9 +164,87 @@ def _ring_shard(q, k, v, kmask, *, axis_name, causal, scale):
     return (o / safe_l[..., None]).astype(q.dtype)
 
 
+def _ring_shard_flash(q, k, v, kmask, *, axis_name, causal, size):
+    """Per-shard ring attention with the Pallas flash kernel as the hop math.
+
+    Each hop runs flash attention on the resident Q block against the
+    currently-held K/V block (``return_lse``), and partial attentions merge
+    via the log-sum-exp combine ``o = o·e^{lse-lse'} + o_hop·e^{lse_hop-lse'}``.
+    Gradients flow through both flash outputs (the lse cotangent folds into
+    the flash backward kernels — see ``_flash_backward``).
+
+    Hop 0 (the diagonal — this device's own K/V block) runs outside the loop
+    so the causal flag can be static (causal-local flash); hops 1..size-1
+    share ONE flash instance inside a ``fori_loop`` — compile time and
+    executable size stay constant in the axis size.  At hop ``step`` this
+    device holds the K/V block of source shard ``(my_idx - step) % size``,
+    which for a causal mask contributes fully iff ``step <= my_idx`` (all
+    its positions are strictly earlier) — enforced with a traced key mask
+    that zeroes non-contributing hops (flash emits lse = -NEG_INF for
+    fully-masked rows, making the merge a no-op).
+    """
+    my_idx = lax.axis_index(axis_name)
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    perm = [(i, (i + 1) % size) for i in range(size)]
+
+    def merge(o, lse, o_hop, lse_hop):
+        # the -NEG_INF sentinel is finite so every term stays finite
+        # (masked hops get weight exp(-huge) == 0.0 exactly)
+        lse_new = jnp.logaddexp(lse, lse_hop)
+        o_new = (
+            o * jnp.exp(lse - lse_new)[..., None]
+            + o_hop.astype(jnp.float32) * jnp.exp(lse_hop - lse_new)[..., None]
+        )
+        return o_new, lse_new
+
+    def rotate(k, v, km):
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        if km is not None:
+            km = lax.ppermute(km, axis_name, perm)
+        return k, v, km
+
+    # hop 0: diagonal block, static causal flag
+    o_hop, lse_hop = flash_attention(
+        q, k, v, kmask, causal=causal, return_lse=True
+    )
+    o = o_hop.astype(jnp.float32)
+    lse = lse_hop
+
+    def body(step, carry):
+        o, lse, k, v, km = carry
+        k, v, km = rotate(k, v, km)
+        hop_mask = km
+        if causal:
+            valid = (step <= my_idx).astype(jnp.int32)
+            vm = jnp.broadcast_to(valid, (B, Lk))
+            hop_mask = vm if hop_mask is None else hop_mask * vm
+        o_hop, lse_hop = flash_attention(
+            q, k, v, hop_mask, causal=False, return_lse=True
+        )
+        o, lse = merge(o, lse, o_hop, lse_hop)
+        return o, lse, k, v, km
+
+    if size > 1:
+        # carry km as an explicit array only when a mask exists; fori_loop
+        # needs a uniform carry structure
+        if kmask is not None:
+            o, lse, *_ = lax.fori_loop(1, size, body, (o, lse, k, v, kmask))
+        else:
+            def body_nomask(step, carry):
+                o, lse, k, v = carry
+                o, lse, k2, v2, _ = body(step, (o, lse, k, v, None))
+                return o, lse, k2, v2
+
+            o, lse, *_ = lax.fori_loop(1, size, body_nomask, (o, lse, k, v))
+    return o.astype(q.dtype)
+
+
 def ring_attention(
     q, k, v, kmask=None, *, mesh: Mesh, axis_name: str = "seq",
     causal: bool = False, batch_axis: Optional[str] = "data",
+    inner: str = "auto",
 ):
     """Ring attention over sequence shards.
 
@@ -143,18 +254,30 @@ def ring_attention(
         kmask: optional [B, L] key-validity mask (1 = attend).
         mesh: the device mesh holding ``axis_name`` (and ``batch_axis``).
         causal: apply a causal (autoregressive) mask using global positions.
+        inner: per-hop kernel — "auto" (flash when the per-shard length
+            supports it, else dense), "flash" (Pallas, blockwise), or
+            "dense" (einsum reference).
 
     Returns [B, H, L, D] with the same sharding as ``q``.
     """
+    inner = _resolve_inner(inner, q.shape[2] // mesh.shape[axis_name])
     ba = _resolve_batch_axis(q, mesh, axis_name, batch_axis)
     qkv_spec = P(ba, None, axis_name, None)
     mask_spec = P(ba, axis_name)
-    body = functools.partial(
-        _ring_shard,
-        axis_name=axis_name,
-        causal=causal,
-        scale=1.0 / (q.shape[-1] ** 0.5),
-    )
+    if inner == "flash":
+        body = functools.partial(
+            _ring_shard_flash,
+            axis_name=axis_name,
+            causal=causal,
+            size=mesh.shape[axis_name],
+        )
+    else:
+        body = functools.partial(
+            _ring_shard,
+            axis_name=axis_name,
+            causal=causal,
+            scale=1.0 / (q.shape[-1] ** 0.5),
+        )
     if kmask is None:
         fn = shard_map(
             lambda q, k, v: body(q, k, v, None),
@@ -170,28 +293,34 @@ def ring_attention(
     return fn(q, k, v, kmask)
 
 
-def _ulysses_shard(q, k, v, kmask, *, axis_name, causal, scale):
-    """Per-shard Ulysses body: all_to_all to head-sharding, dense attention,
-    all_to_all back.  q/k/v: [B, H, Ls, D] with H the FULL head count."""
-    size = lax.psum(1, axis_name)
+def _ulysses_shard(q, k, v, kmask, *, axis_name, causal, scale, inner):
+    """Per-shard Ulysses body: all_to_all to head-sharding, local attention
+    (flash or dense), all_to_all back.  q/k/v: [B, H, Ls, D] with H the FULL
+    head count."""
     # [B, H, Ls, D] -> [B, H/n, L, D]: split heads (axis 1), concat seq (axis 2)
     qh = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2, tiled=True)
     kh = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2, tiled=True)
     vh = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    km = None
     if kmask is not None:
         km = lax.all_gather(kmask, axis_name, axis=1, tiled=True)  # [B, L]
-    L = qh.shape[2]
-    scores = (
-        jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
-                   kh.astype(jnp.float32)) * scale
-    )
-    if kmask is not None:
-        scores = jnp.where(km[:, None, None, :] > 0, scores, _NEG_INF)
-    if causal:
-        pos = jnp.arange(L)
-        scores = jnp.where(pos[:, None] >= pos[None, :], scores, _NEG_INF)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh.astype(jnp.float32))
+    if inner == "flash":
+        # local attention is a full flash call: no [L, L] score tensor, so
+        # per-device memory after the all-to-all is O(L·D·H/n) not O(L²·H/n)
+        out = flash_attention(qh, kh, vh, km, causal=causal)
+    else:
+        L = qh.shape[2]
+        scores = (
+            jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
+                       kh.astype(jnp.float32)) * scale
+        )
+        if km is not None:
+            scores = jnp.where(km[:, None, None, :] > 0, scores, _NEG_INF)
+        if causal:
+            pos = jnp.arange(L)
+            scores = jnp.where(pos[:, None] >= pos[None, :], scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh.astype(jnp.float32))
     # [B, H/n, L, D] -> [B, H, Ls, D]
     out = lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1, tiled=True)
     return out.astype(q.dtype)
@@ -200,10 +329,14 @@ def _ulysses_shard(q, k, v, kmask, *, axis_name, causal, scale):
 def ulysses_attention(
     q, k, v, kmask=None, *, mesh: Mesh, axis_name: str = "seq",
     causal: bool = False, batch_axis: Optional[str] = "data",
+    inner: str = "auto",
 ):
     """DeepSpeed-Ulysses-style all-to-all sequence parallelism (head count
     must be divisible by the mesh axis size).  Same contract as
-    :func:`ring_attention`."""
+    :func:`ring_attention`; ``inner`` selects the local attention kernel
+    after the all-to-all over the full gathered length ("auto" default =
+    flash when supported, "flash", or "dense")."""
+    inner = _resolve_inner(inner, q.shape[2])
     size = mesh.shape[axis_name]
     if q.shape[1] % size != 0:
         raise ValueError(
@@ -218,6 +351,7 @@ def ulysses_attention(
         axis_name=axis_name,
         causal=causal,
         scale=1.0 / (q.shape[-1] ** 0.5),
+        inner=inner,
     )
     if kmask is None:
         fn = shard_map(
@@ -234,7 +368,7 @@ def ulysses_attention(
     return fn(q, k, v, kmask)
 
 
-def _as_model_attention(impl, mesh, axis_name, batch_axis, causal):
+def _as_model_attention(impl, mesh, axis_name, batch_axis, causal, inner):
     """Adapt ring/ulysses to the ``dense_attention`` signature used by
     stoke_tpu.models.bert (q/k/v [B,H,L,D] + additive bias)."""
 
@@ -251,7 +385,7 @@ def _as_model_attention(impl, mesh, axis_name, batch_axis, causal):
             kmask = (bias[:, 0, 0, :] > -1e8).astype(jnp.int32)
         return impl(
             q, k, v, kmask, mesh=mesh, axis_name=axis_name,
-            causal=causal, batch_axis=batch_axis,
+            causal=causal, batch_axis=batch_axis, inner=inner,
         )
 
     return attention_fn
@@ -259,17 +393,21 @@ def _as_model_attention(impl, mesh, axis_name, batch_axis, causal):
 
 def make_ring_attention(
     mesh: Mesh, axis_name: str = "seq", batch_axis: str = "data",
-    causal: bool = False,
+    causal: bool = False, inner: str = "auto",
 ) -> Callable:
     """Build a ring-attention ``attention_fn`` pluggable into
     ``BertEncoder(attention_fn=...)``."""
-    return _as_model_attention(ring_attention, mesh, axis_name, batch_axis, causal)
+    return _as_model_attention(
+        ring_attention, mesh, axis_name, batch_axis, causal, inner
+    )
 
 
 def make_ulysses_attention(
     mesh: Mesh, axis_name: str = "seq", batch_axis: str = "data",
-    causal: bool = False,
+    causal: bool = False, inner: str = "auto",
 ) -> Callable:
     """Build a Ulysses ``attention_fn`` pluggable into
     ``BertEncoder(attention_fn=...)``."""
-    return _as_model_attention(ulysses_attention, mesh, axis_name, batch_axis, causal)
+    return _as_model_attention(
+        ulysses_attention, mesh, axis_name, batch_axis, causal, inner
+    )

@@ -286,7 +286,8 @@ def _dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(res, g, heads, scale, causal, block_q, block_k, interpret):
+def _flash_backward(res, g, heads, scale, causal, block_q, block_k, interpret,
+                    dlse=None):
     q, k, v, mask, out, lse = res
     do = g
     BH, L, D = q.shape
@@ -295,6 +296,11 @@ def _flash_backward(res, g, heads, scale, causal, block_q, block_k, interpret):
     delta = jnp.sum(
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
     )
+    if dlse is not None:
+        # when lse is itself an output (ring-attention hop composition), its
+        # cotangent folds into the same kernels: d lse_i/d s_ij = p_ij, so
+        # ds = p*(dp - delta + dlse) = p*(dp - (delta - dlse))
+        delta = delta - dlse.astype(jnp.float32)
 
     def specs(maskless_first, grid_inner_is_k):
         idx_q = (lambda bh, a, b: (bh, a, 0)) if grid_inner_is_k else (
@@ -388,12 +394,49 @@ def _flash_bwd_rule(heads, scale, causal, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9)
+)
+def _flash_with_lse(q, k, v, mask, heads, scale, causal, block_q, block_k,
+                    interpret):
+    """Like ``_flash`` but also returns the [BH, L, 1] logsumexp rows —
+    the composition hook for ring attention (hop outputs are re-weighted by
+    their lse, so lse needs a real gradient path)."""
+    return _flash_forward(
+        q, k, v, mask, heads, scale, causal, block_q, block_k, interpret
+    )
+
+
+def _flash_lse_fwd_rule(q, k, v, mask, heads, scale, causal, block_q, block_k,
+                        interpret):
+    out, lse = _flash_forward(
+        q, k, v, mask, heads, scale, causal, block_q, block_k, interpret
+    )
+    return (out, lse), (q, k, v, mask, out, lse)
+
+
+def _flash_lse_bwd_rule(heads, scale, causal, block_q, block_k, interpret,
+                        res, g):
+    do, dlse = g
+    return _flash_backward(
+        res, do, heads, scale, causal, block_q, block_k, interpret, dlse=dlse
+    )
+
+
+_flash_with_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
+
+
 def flash_attention(
     q, k, v, mask=None, *, causal: bool = False,
     block_q: Optional[int] = None, block_k: Optional[int] = None,
-    interpret: Optional[bool] = None,
+    interpret: Optional[bool] = None, return_lse: bool = False,
 ):
     """Flash attention on [B, H, L, D] inputs with optional [B, L] key mask.
+
+    ``return_lse=True`` additionally returns the [B, H, L] logsumexp rows
+    (fully-masked rows get the ``_NEG_INF`` sentinel) — used by ring
+    attention to merge per-hop partial attentions; gradients flow through
+    both outputs.
 
     ``interpret=None`` auto-selects the pallas interpreter off-TPU (tests).
     ``block_q``/``block_k=None`` auto-selects the largest block in
@@ -424,6 +467,12 @@ def flash_attention(
     # Mosaic (8, 128)-or-full tiling rule (see the lse layout note in
     # _fwd_kernel)
     mask3 = None if mask is None else mask.reshape(B, 1, L)
+    if return_lse:
+        out, lse = _flash_with_lse(
+            flat(q), flat(k), flat(v), mask3, H, 1.0 / (D**0.5), causal,
+            block_q, block_k, interpret,
+        )
+        return out.reshape(B, H, L, D), lse.reshape(B, H, L)
     out = _flash(
         flat(q), flat(k), flat(v), mask3, H, 1.0 / (D**0.5), causal,
         block_q, block_k, interpret,

@@ -44,45 +44,55 @@ def dense_ref(q, k, v, kmask=None, causal=False):
 
 IMPLS = {"ring": ring_attention, "ulysses": ulysses_attention}
 MESHES = {"seq8": (1, 8), "data2seq4": (2, 4)}
+INNERS = ["dense", "flash"]
 
 
+@pytest.mark.parametrize("inner", INNERS)
 @pytest.mark.parametrize("impl_name", list(IMPLS))
 @pytest.mark.parametrize("mesh_name", list(MESHES))
-def test_matches_dense_unmasked(impl_name, mesh_name, rng, devices):
+def test_matches_dense_unmasked(impl_name, mesh_name, inner, rng, devices):
     mesh = mesh_2d(*MESHES[mesh_name])
     q, k, v = qkv(rng)
-    out = IMPLS[impl_name](q, k, v, mesh=mesh, axis_name="seq")
+    out = IMPLS[impl_name](q, k, v, mesh=mesh, axis_name="seq", inner=inner)
     ref = dense_ref(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.parametrize("inner", INNERS)
 @pytest.mark.parametrize("impl_name", list(IMPLS))
-def test_matches_dense_with_padding_mask(impl_name, rng, devices):
+def test_matches_dense_with_padding_mask(impl_name, inner, rng, devices):
     mesh = mesh_2d(2, 4)
     q, k, v = qkv(rng)
     km = key_mask(rng)
-    out = IMPLS[impl_name](q, k, v, km, mesh=mesh, axis_name="seq")
+    out = IMPLS[impl_name](q, k, v, km, mesh=mesh, axis_name="seq", inner=inner)
     ref = dense_ref(q, k, v, km)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.parametrize("inner", INNERS)
 @pytest.mark.parametrize("impl_name", list(IMPLS))
-def test_matches_dense_causal(impl_name, rng, devices):
+def test_matches_dense_causal(impl_name, inner, rng, devices):
     mesh = mesh_2d(1, 8)
     q, k, v = qkv(rng)
-    out = IMPLS[impl_name](q, k, v, mesh=mesh, axis_name="seq", causal=True)
+    out = IMPLS[impl_name](q, k, v, mesh=mesh, axis_name="seq", causal=True,
+                           inner=inner)
     ref = dense_ref(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6)
 
 
-def test_ring_grads_match_dense(rng, devices):
-    """Backward pass through the ring (ppermute in fori_loop) must match
-    dense-attention gradients — training viability, not just inference."""
+@pytest.mark.parametrize("inner", INNERS)
+def test_ring_grads_match_dense(inner, rng, devices):
+    """Backward pass through the ring must match dense-attention gradients —
+    training viability, not just inference.  The flash inner additionally
+    exercises the lse-cotangent path through the Pallas backward kernels
+    (hop merge re-weights by lse, so d/d lse must be exact)."""
     mesh = mesh_2d(1, 8)
     q, k, v = qkv(rng)
 
     def loss_ring(q, k, v):
-        return jnp.sum(ring_attention(q, k, v, mesh=mesh, axis_name="seq") ** 2)
+        return jnp.sum(
+            ring_attention(q, k, v, mesh=mesh, axis_name="seq", inner=inner) ** 2
+        )
 
     def loss_dense(q, k, v):
         return jnp.sum(dense_ref(q, k, v) ** 2)
@@ -93,6 +103,51 @@ def test_ring_grads_match_dense(rng, devices):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("impl_name", list(IMPLS))
+def test_flash_inner_grads_causal_masked(impl_name, rng, devices):
+    """Flash-inner ring/Ulysses gradients under causal + padding mask — the
+    hardest composition (static per-hop causality, rotating key masks,
+    all-gathered masks) — must match the dense reference."""
+    mesh = mesh_2d(1, 8)
+    q, k, v = qkv(rng)
+    km = key_mask(rng)
+
+    def loss_sp(q, k, v):
+        out = IMPLS[impl_name](
+            q, k, v, km, mesh=mesh, axis_name="seq", causal=True, inner="flash"
+        )
+        return jnp.sum(out ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_ref(q, k, v, km, causal=True) ** 2)
+
+    g_sp = jax.grad(loss_sp, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_sp, g_dense):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_flash_return_lse(rng, devices):
+    """flash_attention(return_lse=True) returns logsumexp rows matching the
+    dense computation, with the -inf sentinel on fully-masked rows."""
+    from stoke_tpu.ops import flash_attention
+
+    q, k, v = qkv(rng)
+    m = np.ones((B, L), np.int32)
+    m[0, :] = 0  # sample 0 fully masked
+    km = jnp.asarray(m)
+    out, lse = flash_attention(q, k, v, km, return_lse=True, block_q=16, block_k=16)
+    assert out.shape == (B, H, L, D) and lse.shape == (B, H, L)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (D ** 0.5)
+    s = jnp.where(km[:, None, None, :] > 0, s, -jnp.inf)
+    ref_lse = jax.nn.logsumexp(s, axis=-1)  # -inf where fully masked
+    np.testing.assert_allclose(
+        np.asarray(lse[1]), np.asarray(ref_lse[1]), rtol=1e-5, atol=1e-5
+    )
+    assert np.all(np.asarray(lse[0]) < -1e29)  # sentinel on masked sample
+    np.testing.assert_array_equal(np.asarray(out[0]), 0.0)
+
+
 def test_ulysses_rejects_indivisible_heads(rng, devices):
     mesh = mesh_2d(1, 8)
     q = jnp.zeros((B, 6, L, D))  # 6 heads not divisible by 8
@@ -100,12 +155,14 @@ def test_ulysses_rejects_indivisible_heads(rng, devices):
         ulysses_attention(q, q, q, mesh=mesh, axis_name="seq")
 
 
-def test_fully_masked_rows_are_zero(rng, devices):
-    """All-padding samples must produce zeros, not NaN (the l==0 guard)."""
+@pytest.mark.parametrize("inner", INNERS)
+def test_fully_masked_rows_are_zero(inner, rng, devices):
+    """All-padding samples must produce zeros, not NaN (the l==0 guard /
+    the finite -NEG_INF lse sentinel in the flash hop merge)."""
     mesh = mesh_2d(1, 8)
     q, k, v = qkv(rng)
     km = jnp.zeros((B, L), jnp.int32)
-    out = ring_attention(q, k, v, km, mesh=mesh, axis_name="seq")
+    out = ring_attention(q, k, v, km, mesh=mesh, axis_name="seq", inner=inner)
     assert not np.isnan(np.asarray(out)).any()
     np.testing.assert_array_equal(np.asarray(out), 0.0)
 
@@ -275,3 +332,28 @@ def test_flash_auto_blocks_numerics(rng, devices):
     ref = dense_reference(q, k, v, mask, causal=True)
     err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
     assert err < FWD_ATOL_BF16, err
+
+
+def test_inner_auto_falls_back_to_dense_on_awkward_length(rng, devices):
+    """inner="auto" (the default) must keep any pre-flash sequence length
+    working: L=520 gathers to a local length >512 not divisible by any flash
+    block candidate, so Ulysses auto-resolves to the dense inner — while an
+    explicit inner="flash" raises the actionable block error."""
+    mesh = mesh_2d(1, 8)
+    L2 = 520  # 520/8 = 65 per shard; gathered 520 has no flash block
+    r = np.random.default_rng(7)
+    mk = lambda: jnp.asarray(r.normal(size=(1, 8, L2, 8)).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+    out = ulysses_attention(q, k, v, mesh=mesh, axis_name="seq")  # auto
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (8 ** 0.5)
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+    with pytest.raises(ValueError, match="no candidate"):
+        ulysses_attention(q, k, v, mesh=mesh, axis_name="seq", inner="flash")
+    # ring's per-shard length (65) is flash-friendly -> auto picks flash
+    from stoke_tpu.ops.attention import _resolve_inner
+    assert _resolve_inner("auto", 65) == "flash"
+    assert _resolve_inner("auto", 520) == "dense"
+    with pytest.raises(ValueError, match="inner must be"):
+        ulysses_attention(q, k, v, mesh=mesh, axis_name="seq", inner="bogus")

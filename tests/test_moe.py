@@ -198,3 +198,217 @@ def test_moe_trains_through_facade_with_ep(rng, devices):
     for _ in range(10):
         l = float(s.train_step(x, y))
     assert l < l0
+
+
+# ------------------------- load balancing (round 3) ------------------------ #
+
+
+def _route_fractions(params, x):
+    """Host-side recompute of first-choice expert fractions from the router."""
+    tokens = np.asarray(x).reshape(-1, x.shape[-1])
+    logits = tokens @ np.asarray(params["moe"]["router"]["kernel"])
+    eidx = logits.argmax(-1)
+    return np.bincount(eidx, minlength=E) / len(eidx)
+
+
+def _collapsed_stoke(aux_loss_weight):
+    """MoE facade run whose router is surgically collapsed onto expert 0:
+    positive inputs + a kernel whose column 0 dominates make every token's
+    argmax expert 0 deterministically."""
+    import flax.linen as nn
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = True):
+            return MoEFFN(hidden=H, ff=FF, num_experts=E,
+                          capacity_factor=4.0, name="moe")(x, train=train)
+
+    r = np.random.default_rng(3)
+    x = np.abs(r.normal(size=(4, L, H))).astype(np.float32)  # positive inputs
+    net = Net()
+    v = init_module(net, jax.random.PRNGKey(0), x, train=False)
+    params = jax.tree_util.tree_map(np.asarray, v["params"])
+    # column 0 dominates for positive x; the other columns carry small
+    # random preferences so tokens can disperse once dominance is ground
+    # down (distinct per-token runner-up experts, as in a real router)
+    kernel = (0.3 * r.normal(size=(H, E))).astype(np.float32)
+    kernel[:, 0] = 1.0
+    params["moe"]["router"]["kernel"] = kernel
+    v = {**v, "params": params}
+    s = Stoke(
+        model=net,
+        optimizer=StokeOptimizer(
+            optimizer=optax.adam, optimizer_kwargs={"learning_rate": 0.05}
+        ),
+        # the task loss is indifferent to routing: only the aux term can
+        # (and must) redistribute the experts
+        loss=lambda out, y: 0.0 * jnp.sum(out),
+        params=v,
+        batch_size_per_device=4,
+        device="cpu",
+        aux_loss_weight=aux_loss_weight,
+        model_train_kwargs={"train": True},
+        model_eval_kwargs={"train": False},
+        verbose=False,
+    )
+    return s, x
+
+
+def test_router_collapse_without_aux_loss(rng):
+    """With aux_loss_weight=0 a collapsed router stays collapsed (this is
+    the failure mode the aux loss exists to fix)."""
+    s, x = _collapsed_stoke(aux_loss_weight=0.0)
+    assert _route_fractions(s.params, x).max() == 1.0
+    y = np.zeros((4,), np.int32)
+    for _ in range(50):
+        s.train_step(x, y)
+    assert _route_fractions(s.params, x).max() == 1.0  # still collapsed
+
+
+def test_aux_loss_rebalances_collapsed_router(rng):
+    """With the Switch aux loss in the objective the same collapsed router
+    redistributes tokens across experts within 50 steps."""
+    s, x = _collapsed_stoke(aux_loss_weight=1.0)
+    assert _route_fractions(s.params, x).max() == 1.0
+    assert s.aux_losses is not None  # sown from init; live after steps
+    y = np.zeros((4,), np.int32)
+    for _ in range(50):
+        s.train_step(x, y)
+    frac = _route_fractions(s.params, x)
+    assert frac.max() < 0.9, frac  # no expert hoards the tokens
+    aux_now = float(jax.tree_util.tree_leaves(s.aux_losses)[0])
+    # aux ≈ E·Σ f·P: collapsed start ≈ E·P_0·1 → rebalanced value near 1
+    assert aux_now < 2.0, aux_now
+
+
+def test_aux_loss_value_uniform_vs_collapsed(rng):
+    """aux = E·Σ f_e·P_e: ≈1 for uniform routing, ≈E·P_max when collapsed."""
+    moe = make_moe()
+    x = jnp.asarray(rng.normal(size=(B, L, H)).astype(np.float32))
+    v = init_module(moe, jax.random.PRNGKey(0), x, train=False)
+    _, state = moe.apply(v, x, train=True, mutable=["losses"])
+    aux = float(jax.tree_util.tree_leaves(state["losses"])[0])
+    assert aux >= 1.0 - 1e-5  # lower bound, equality at uniform
+
+
+def test_top2_routing_matches_manual(rng):
+    """top_k=2: output is the gate-weighted sum of the two top experts
+    (normalized gates), given ample capacity."""
+    moe = MoEFFN(hidden=H, ff=FF, num_experts=E, capacity_factor=8.0, top_k=2)
+    x = jnp.asarray(rng.normal(size=(B, L, H)).astype(np.float32))
+    v = init_module(moe, jax.random.PRNGKey(0), x, train=False)
+    params = v["params"]
+    out = moe.apply(v, x, train=False)
+
+    tokens = np.asarray(x).reshape(-1, H)
+    logits = tokens @ np.asarray(params["router"]["kernel"])
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    order = np.argsort(-p, axis=-1)[:, :2]
+    w_in = np.asarray(params["w_in"])
+    w_out = np.asarray(params["w_out"])
+    ref = []
+    for n in range(tokens.shape[0]):
+        e1, e2 = order[n]
+        g1, g2 = p[n, e1], p[n, e2]
+        z = g1 + g2
+        y1 = np.asarray(jax.nn.gelu(tokens[n] @ w_in[e1])) @ w_out[e1]
+        y2 = np.asarray(jax.nn.gelu(tokens[n] @ w_in[e2])) @ w_out[e2]
+        ref.append((g1 / z) * y1 + (g2 / z) * y2)
+    ref = np.stack(ref).reshape(B, L, H)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
+
+
+def test_top2_capacity_priority(rng):
+    """First choices claim capacity before second choices (choice-major
+    priority): a LATER token's first choice beats an EARLIER token's second
+    choice for the same expert's queue — token-major priority would invert
+    this and is the regression this test pins down."""
+    E2 = 2
+    moe = MoEFFN(hidden=H, ff=FF, num_experts=E2, capacity_factor=1.0, top_k=2)
+    # one group, 4 tokens, each a distinct unit feature so the router logits
+    # can be dictated exactly through the kernel
+    x = np.zeros((1, 4, H), np.float32)
+    for t in range(4):
+        x[0, t, t] = 1.0
+    x = jnp.asarray(x)
+    v = init_module(moe, jax.random.PRNGKey(0), x, train=False)
+    params = jax.tree_util.tree_map(np.asarray, v["params"])
+    # tokens 0,1: first choice e1, second e0; tokens 2,3: first e0, second e1
+    kernel = np.zeros((H, E2), np.float32)
+    kernel[0] = kernel[1] = [1.0, 2.0]
+    kernel[2] = kernel[3] = [2.0, 1.0]
+    params["router"]["kernel"] = kernel
+    out = np.asarray(
+        moe.apply({"params": params}, x, train=False)
+    ).reshape(4, H)
+
+    # capacity C = ceil(S/E)*1 = 2 per expert.  Choice-major priority:
+    # e0's queue takes first-choices t2,t3; the second choices of t0,t1
+    # overflow.  e1's queue takes first-choices t0,t1; seconds of t2,t3
+    # overflow.  So every token keeps ONLY its first-choice contribution,
+    # with the top-2-normalized gate.
+    tokens = np.asarray(x).reshape(4, H)
+    logits = tokens @ kernel
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    w_in = np.asarray(params["w_in"])
+    w_out = np.asarray(params["w_out"])
+    for t, first in [(0, 1), (1, 1), (2, 0), (3, 0)]:
+        g_first = p[t, first]
+        g_norm = g_first / (p[t, 0] + p[t, 1])  # top-2 covers both experts
+        ref = g_norm * (
+            np.asarray(jax.nn.gelu(tokens[t] @ w_in[first])) @ w_out[first]
+        )
+        np.testing.assert_allclose(out[t], ref, rtol=2e-4, atol=2e-5)
+
+    # with ample capacity the dropped second choices come back
+    moe_big = MoEFFN(hidden=H, ff=FF, num_experts=E2, capacity_factor=4.0,
+                     top_k=2)
+    out_big = np.asarray(
+        moe_big.apply({"params": params}, x, train=False)
+    ).reshape(4, H)
+    for t, (first, second) in enumerate([(1, 0), (1, 0), (0, 1), (0, 1)]):
+        z = p[t, 0] + p[t, 1]
+        ref = (p[t, first] / z) * (
+            np.asarray(jax.nn.gelu(tokens[t] @ w_in[first])) @ w_out[first]
+        ) + (p[t, second] / z) * (
+            np.asarray(jax.nn.gelu(tokens[t] @ w_in[second])) @ w_out[second]
+        )
+        np.testing.assert_allclose(out_big[t], ref, rtol=2e-4, atol=2e-5)
+
+
+def test_top_k_validation(rng):
+    with pytest.raises(ValueError, match="top_k must be"):
+        MoEFFN(hidden=H, ff=FF, num_experts=2, top_k=3).apply(
+            {"params": {}}, jnp.zeros((1, 4, H)), train=False
+        )
+
+
+def test_gpt_moe_top2_trains(rng):
+    """GPT with top-2 MoE layers trains end to end and exposes aux losses."""
+    from stoke_tpu.models import GPT, causal_lm_loss
+
+    model = GPT(vocab_size=32, size_name="tiny", max_len=32, dropout_rate=0.0,
+                moe_num_experts=E, moe_every=2, moe_capacity_factor=4.0,
+                moe_top_k=2)
+    seq = np.tile(np.arange(16, dtype=np.int32), 2)[None, :].repeat(4, 0)
+    v = init_module(model, jax.random.PRNGKey(0), seq, train=False)
+    assert "losses" in v  # router sows the balancing loss from init
+    s = Stoke(
+        model=model,
+        optimizer=StokeOptimizer(
+            optimizer=optax.adam, optimizer_kwargs={"learning_rate": 3e-3}
+        ),
+        loss=causal_lm_loss,
+        params=v,
+        batch_size_per_device=4,
+        device="cpu",
+        verbose=False,
+    )
+    l0 = float(s.train_step(seq, seq))
+    for _ in range(10):
+        l = float(s.train_step(seq, seq))
+    assert l < l0
+    aux = jax.tree_util.tree_leaves(s.aux_losses)
+    assert aux and float(aux[0]) > 0.0  # live balancing term in state
