@@ -122,6 +122,26 @@ def _save_sharded(tag_dir: str, state: Dict[str, Any]) -> None:
     ckpt.wait_until_finished()
 
 
+def _save_sharded_async(tag_dir: str, state: Dict[str, Any]) -> list:
+    """Kick off orbax async sharded writes; returns the checkpointer handles.
+
+    ``AsyncCheckpointer.save`` copies device shards to host ON THE CALLING
+    (main) thread, then serializes + writes in orbax's own background
+    machinery — including the cross-process commit coordination (the
+    distributed KV-store barriers ride gRPC, not XLA collectives, so they
+    are safe off the main thread).  Every process must create/save in the
+    same order so the barrier keys line up."""
+    import orbax.checkpoint as ocp
+
+    handles = []
+    for key, tree in state.items():
+        c = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        c.save(os.path.join(tag_dir, f"{key}.orbax"),
+               args=ocp.args.StandardSave(tree))
+        handles.append(c)
+    return handles
+
+
 def _load_sharded(tag_dir: str, key: str, like: Any) -> Any:
     ckpt = _orbax_checkpointer()
     abstract = jax.tree_util.tree_map(
@@ -158,7 +178,7 @@ def save_checkpoint(
     root = make_folder(path)
     tag = checkpoint_tag(name, backward_step)
     tag_dir = os.path.join(root, tag)
-    is_async = config.async_save and not _is_multiprocess()
+    is_async = bool(config.async_save)
     if is_async:
         # claim the tag BEFORE creating the dir: a concurrently finishing
         # earlier async save's _prune_old must never classify this (still
@@ -180,69 +200,100 @@ def save_checkpoint(
     }
     if grad_buf is not None:
         state["grad_buf"] = grad_buf
+    def _write_meta_files(fmt_value: str) -> None:
+        """meta.json + extras.pkl — process 0 only; shared by the sync and
+        async paths so the metadata schema can never drift between them."""
+        if jax.process_index() != 0:
+            return
+        meta = {
+            "format": fmt_value,
+            "counters": counters,
+            "status": status,
+            "name": name,
+        }
+        with open(os.path.join(tag_dir, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=2, default=str)
+        if extras:
+            with open(os.path.join(tag_dir, "extras.pkl"), "wb") as f:
+                pickle.dump(extras, f)
+
     def _write_meta():
         if jax.process_index() == 0:
-            meta = {
-                "format": config.format.value,
-                "counters": counters,
-                "status": status,
-                "name": name,
-            }
-            with open(os.path.join(tag_dir, "meta.json"), "w") as f:
-                json.dump(meta, f, indent=2, default=str)
-            if extras:
-                with open(os.path.join(tag_dir, "extras.pkl"), "wb") as f:
-                    pickle.dump(extras, f)
+            _write_meta_files(config.format.value)
             _prune_old(root, name, config.max_to_keep)
             unrolled_print(f"Saved checkpoint {tag_dir}")
 
     if is_async:
-        # Async save: the device→host gather happens HERE, synchronously —
-        # the compiled steps donate (invalidate) state buffers, so a
-        # background thread must never touch device arrays.  Only the slow
-        # part (serialization + disk) runs in the thread.  meta.json is
-        # written last so a crash mid-save never leaves a loadable partial
-        # tag (load requires meta.json).  Multi-process saves stay
-        # synchronous (gather collectives must run on the main thread).
-        try:
-            host_state = {k: _gather_to_host(v) for k, v in state.items()}
-        except BaseException:
-            _INFLIGHT_TAGS.discard(tag_dir)  # claim released on gather failure
-            raise
-
-        def _bg():
+        # Async save: anything touching DEVICE arrays or XLA collectives
+        # happens HERE, synchronously on the main thread — the compiled
+        # steps donate (invalidate) state buffers, and multi-host gather
+        # collectives cannot run off-thread.  Only serialization + disk
+        # (and orbax's gRPC commit coordination) runs in the background.
+        # meta.json is written last — and, multi-process, only after the
+        # global commit — so a crash mid-save never leaves a loadable
+        # partial tag (load requires meta.json).
+        is_proc0 = jax.process_index() == 0
+        if config.format is CheckpointFormat.sharded:
+            # orbax AsyncCheckpointer: device→host copy on this thread,
+            # sharded tensorstore writes + cross-host commit in background
             try:
+                handles = _save_sharded_async(tag_dir, state)
+            except BaseException:
+                _INFLIGHT_TAGS.discard(tag_dir)
+                raise
+
+            def _write_payload():
+                for h in handles:
+                    # returns after THIS process's writes are durable and
+                    # the cross-process commit barrier has passed — on
+                    # process 0 that makes meta.json a global completeness
+                    # marker.  close() releases the checkpointer's
+                    # background machinery (a fresh one is built per save;
+                    # leaving them open leaks threads across a long run)
+                    h.wait_until_finished()
+                    h.close()
+
+            fmt_value = CheckpointFormat.sharded.value
+        else:
+            # consolidated: gather (collective, main thread) → proc-0 write
+            try:
+                host_state = {k: _gather_to_host(v) for k, v in state.items()}
+            except BaseException:
+                _INFLIGHT_TAGS.discard(tag_dir)  # claim released on gather failure
+                raise
+
+            def _write_payload():
+                if not is_proc0:
+                    return
                 for key, tree in host_state.items():
                     leaves, _ = _flat_arrays(tree)
                     np.savez(
                         os.path.join(tag_dir, f"{key}.npz"),
-                        **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)},
+                        **{f"leaf_{i}": np.asarray(l)
+                           for i, l in enumerate(leaves)},
                     )
-                # async writes use the consolidated layout regardless of the
-                # configured format; record that so load() reads it back right
-                meta = {
-                    "format": CheckpointFormat.consolidated.value,
-                    "counters": counters,
-                    "status": status,
-                    "name": name,
-                }
-                with open(os.path.join(tag_dir, "meta.json"), "w") as f:
-                    json.dump(meta, f, indent=2, default=str)
-                if extras:
-                    with open(os.path.join(tag_dir, "extras.pkl"), "wb") as f:
-                        pickle.dump(extras, f)
+
+            fmt_value = CheckpointFormat.consolidated.value
+
+        def _bg():
+            try:
+                _write_payload()
+                _write_meta_files(fmt_value)
                 # meta.json is on disk: this tag is now a complete, loadable
                 # checkpoint — leave the in-flight set BEFORE pruning so it
                 # counts toward its own keep window
                 _INFLIGHT_TAGS.discard(tag_dir)
-                _prune_old(root, name, config.max_to_keep)
-                unrolled_print(f"Saved checkpoint {tag_dir} (async)")
+                if is_proc0:
+                    _prune_old(root, name, config.max_to_keep)
+                    unrolled_print(f"Saved checkpoint {tag_dir} (async)")
             except BaseException as e:  # surfaced by wait_for_saves()
                 # write-phase failure → remove the partial tag (it can never
                 # load without meta.json).  A failure AFTER meta.json exists
                 # (e.g. a transient error inside _prune_old) leaves the
                 # complete, loadable checkpoint in place.
-                if not os.path.exists(os.path.join(tag_dir, "meta.json")):
+                if is_proc0 and not os.path.exists(
+                    os.path.join(tag_dir, "meta.json")
+                ):
                     shutil.rmtree(tag_dir, ignore_errors=True)
                 _ASYNC_ERRORS.append((tag_dir, e))
             finally:
@@ -270,11 +321,18 @@ def wait_for_saves() -> None:
     """Block until all in-flight async checkpoint saves complete (call
     before exiting or before loading a just-saved checkpoint).
 
+    Multi-process, ends with a global barrier: a non-zero process's
+    background thread can finish before process 0 has written ``meta.json``,
+    so without the barrier "my threads are done" would not mean "the
+    checkpoint is loadable".  The barrier runs before errors are raised so
+    a failing process never strands its peers mid-barrier.
+
     Raises the first background-save failure (disk full, serialization
     error, ...) rather than silently dropping it — a checkpoint that was
     never written must not look saved (ADVICE r1: io_ops medium)."""
     while _ASYNC_SAVES:
         _ASYNC_SAVES.pop().join()
+    _barrier()
     if _ASYNC_ERRORS:
         tag_dir, err = _ASYNC_ERRORS[0]
         rest = len(_ASYNC_ERRORS) - 1

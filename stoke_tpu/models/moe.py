@@ -42,7 +42,9 @@ class MoEFFN(nn.Module):
         ff: per-expert feed-forward width.
         num_experts: expert count E (shard over the mesh ``expert`` axis via
             :func:`moe_expert_parallel_rules` for EP).
-        capacity_factor: per-expert capacity = ceil(N/E) * factor.
+        capacity_factor: per-expert capacity = ceil(top_k·N/E) * factor
+            (scaled by top_k per the GShard convention, so k=2 at the
+            default factor does not structurally drop second choices).
         router_noise: train-time logit jitter (load balancing aid); needs the
             ``router`` rng stream when > 0.
         top_k: experts per token (1 = Switch, 2 = GShard-style with
@@ -68,7 +70,10 @@ class MoEFFN(nn.Module):
         k = self.top_k
         if not 1 <= k <= E:
             raise ValueError(f"MoEFFN: top_k must be in [1, {E}], got {k}")
-        C = max(1, int(np.ceil(S / E) * self.capacity_factor))
+        # GShard convention: tokens produce k assignments, so per-expert
+        # capacity scales with k — otherwise top-2 at the default factor
+        # would structurally drop every second choice
+        C = max(1, int(np.ceil(k * S / E) * self.capacity_factor))
 
         logits = nn.Dense(E, use_bias=False, name="router")(x)  # [G, S, E]
         if self.router_noise > 0.0 and train:

@@ -45,7 +45,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .flash_attention import _pick_block, flash_attention
+from .flash_attention import DEFAULT_BLOCK_Q, _pick_block, flash_attention
 
 try:  # jax >= 0.4.35 exposes shard_map at top level
     from jax import shard_map as _shard_map_fn
@@ -80,7 +80,7 @@ def _resolve_inner(inner: str, L: int) -> str:
     if inner != "auto":
         return inner
     try:
-        _pick_block(None, L, 512)
+        _pick_block(None, L, DEFAULT_BLOCK_Q)
         return "flash"
     except ValueError:
         return "dense"

@@ -169,10 +169,25 @@ def pipeline(
         (act, queue, outbuf), _ = lax.scan(
             tick, (act0, queue0, outbuf0), jnp.arange(T)
         )
-        # only the last device holds real outputs; psum replicates them
+        # only the last device holds real outputs
         outbuf = _tree_where(stage == S - 1, outbuf, jax.tree_util.tree_map(
             jnp.zeros_like, outbuf
         ))
+        if M % S == 0:
+            # emit via reduce-scatter: every output byte crosses the wire
+            # ONCE (vs twice for a full psum of the mostly-zero buffer) and
+            # each device ends up owning M/S microbatch rows — the same
+            # global [M, ...] array, sharded over the stage axis, so
+            # downstream per-microbatch work (edges, loss) parallelizes
+            # over stages instead of replicating, and GSPMD reshards only
+            # if something actually needs replication
+            return jax.tree_util.tree_map(
+                lambda a: lax.psum_scatter(
+                    a, axis_name, scatter_dimension=0, tiled=True
+                ),
+                outbuf,
+            )
+        # indivisible M: replicate via psum (correct for any M)
         return jax.tree_util.tree_map(
             lambda a: lax.psum(a, axis_name), outbuf
         )
@@ -191,10 +206,20 @@ def pipeline(
             lambda a: P(None, axis_name, *([None] * (a.ndim - 2))), grouped
         )
         xs_specs = jax.tree_util.tree_map(lambda a: P(), xs)
+        M = jax.tree_util.tree_leaves(xs)[0].shape[0]
+        # match the emit path: reduce-scattered outputs are sharded over the
+        # stage axis on the microbatch dim (same global array)
+        out_specs = (
+            jax.tree_util.tree_map(
+                lambda a: P(axis_name, *([None] * (a.ndim - 1))), xs
+            )
+            if M % S == 0
+            else xs_specs
+        )
         fn = shard_map(
             per_shard, mesh,
             in_specs=(param_specs, xs_specs),
-            out_specs=xs_specs,
+            out_specs=out_specs,
         )
         return fn(grouped, xs)
 

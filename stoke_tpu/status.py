@@ -269,15 +269,26 @@ class StokeStatus:
                 )
             return False
 
-        def _tensorboard_unimportable(s):
-            if "TensorboardConfig" not in self._configs:
+        def _tensorboard_writable(s):
+            # metrics use the in-repo native event writer
+            # (utils/tb_writer.py) — no import to validate, but the output
+            # path must be creatable so failures surface at init, not at
+            # the first mid-training log call
+            cfg = self._configs.get("TensorboardConfig")
+            if cfg is None:
                 return False
-            try:
-                import torch.utils.tensorboard  # noqa: F401
+            import os
 
+            try:
+                os.makedirs(
+                    os.path.join(cfg.output_path, cfg.job_name), exist_ok=True
+                )
                 return False
-            except Exception:
-                return True
+            except OSError as e:
+                return (
+                    f"TensorboardConfig output path "
+                    f"{cfg.output_path!r}/{cfg.job_name!r} is not creatable: {e}"
+                )
 
         def _offload_cpu_no_fallback(s):
             for name in ("OffloadOptimizerConfig", "OffloadParamsConfig"):
@@ -373,9 +384,8 @@ class StokeStatus:
             ),
             # --- dependency checks ---
             (
-                _tensorboard_unimportable,
-                "TensorboardConfig requires torch (torch.utils.tensorboard) "
-                "which is not importable in this environment",
+                _tensorboard_writable,
+                "TensorboardConfig output path is not writable",
             ),
             (
                 _offload_cpu_no_fallback,

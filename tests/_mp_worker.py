@@ -53,7 +53,7 @@ IN, OUT = 8, 4
 GLOBAL_BATCH = 32
 
 
-def make_stoke(fmt=CheckpointFormat.consolidated, fsdp=False):
+def make_stoke(fmt=CheckpointFormat.consolidated, fsdp=False, async_save=False):
     params = {
         "w": jnp.asarray(
             np.random.default_rng(7).normal(size=(IN, OUT)).astype(np.float32) * 0.1
@@ -65,7 +65,7 @@ def make_stoke(fmt=CheckpointFormat.consolidated, fsdp=False):
             num_processes=NPROC,
             process_id=PID,
         ),
-        CheckpointConfig(format=fmt),
+        CheckpointConfig(format=fmt, async_save=async_save),
     ]
     if fsdp:
         cfgs.append(FSDPConfig(min_weight_size=1))
@@ -153,6 +153,33 @@ def main():
         a = multihost_utils.process_allgather(s.params["w"], tiled=True)
         b = multihost_utils.process_allgather(s2.params["w"], tiled=True)
         np.testing.assert_allclose(b, a, rtol=1e-6)
+
+    elif SCENARIO == "async_sharded_save":
+        # multi-host ASYNC sharded save (round-3): orbax AsyncCheckpointer
+        # copies device shards to host on the main thread, writes + runs the
+        # cross-process commit in background; meta.json appears only after
+        # the global commit, training continues during the write
+        import json as _json
+
+        from jax.experimental import multihost_utils
+
+        s = train(make_stoke(fmt=CheckpointFormat.sharded, fsdp=True,
+                             async_save=True))
+        tag_dir = s.save(os.path.join(TMP, "ckpt_async"), name="mp")
+        w_at_save = multihost_utils.process_allgather(s.params["w"], tiled=True)
+        s = train(s, steps=2)  # keep training while the save runs
+        # wait_for_checkpoint ends with a global barrier, so meta.json is
+        # guaranteed on disk for EVERY process right after — no extra
+        # barrier needed before loading
+        s.wait_for_checkpoint()
+        with open(os.path.join(tag_dir, "meta.json")) as f:
+            assert _json.load(f)["format"] == "sharded"
+        assert os.path.exists(os.path.join(tag_dir, "variables.orbax"))
+        s2 = make_stoke(fmt=CheckpointFormat.sharded, fsdp=True)
+        s2.load(os.path.join(TMP, "ckpt_async"), name="mp")
+        assert s2.backward_steps == 3 and s2.optimizer_steps == 3
+        b = multihost_utils.process_allgather(s2.params["w"], tiled=True)
+        np.testing.assert_allclose(b, w_at_save, rtol=1e-6)
 
     elif SCENARIO == "loader":
         # multi-process DataLoader REQUIRES a distributed sampler

@@ -329,3 +329,32 @@ def test_structure_mismatch_rejected(tmp_path):
     )
     with pytest.raises(ValueError):
         other.load(path)
+
+
+def test_async_sharded_save_roundtrip(tmp_path):
+    """async_save + sharded format: orbax async writes (device→host copy on
+    the main thread, tensorstore writes in background) round-trip exactly,
+    and meta.json records the sharded layout."""
+    import json
+    import os
+
+    from stoke_tpu import CheckpointConfig
+
+    s = train_a_bit(
+        make(configs=[CheckpointConfig(
+            format=CheckpointFormat.sharded, async_save=True)]),
+        steps=2,
+    )
+    path = str(tmp_path / "ckpt")
+    tag_dir = s.save(path)
+    w_at_save = np.asarray(s.params["w1"]).copy()
+    s = train_a_bit(s, steps=2)  # keep training while the save runs
+    s.wait_for_checkpoint()
+    with open(os.path.join(tag_dir, "meta.json")) as f:
+        assert json.load(f)["format"] == "sharded"
+    assert os.path.exists(os.path.join(tag_dir, "variables.orbax"))
+
+    s2 = make(fmt=CheckpointFormat.sharded)
+    s2.load(path)
+    assert s2.optimizer_steps == 2
+    np.testing.assert_allclose(np.asarray(s2.params["w1"]), w_at_save, rtol=1e-6)

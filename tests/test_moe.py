@@ -325,7 +325,9 @@ def test_top2_capacity_priority(rng):
     choice for the same expert's queue — token-major priority would invert
     this and is the regression this test pins down."""
     E2 = 2
-    moe = MoEFFN(hidden=H, ff=FF, num_experts=E2, capacity_factor=1.0, top_k=2)
+    # capacity C = ceil(k*S/E)*factor = ceil(2*4/2)*0.5 = 2: room for the
+    # first-choice load only, so all second choices must overflow
+    moe = MoEFFN(hidden=H, ff=FF, num_experts=E2, capacity_factor=0.5, top_k=2)
     # one group, 4 tokens, each a distinct unit feature so the router logits
     # can be dictated exactly through the kernel
     x = np.zeros((1, 4, H), np.float32)
@@ -412,3 +414,34 @@ def test_gpt_moe_top2_trains(rng):
     assert l < l0
     aux = jax.tree_util.tree_leaves(s.aux_losses)
     assert aux and float(aux[0]) > 0.0  # live balancing term in state
+
+
+def test_moe_checkpoint_excludes_transient_losses(tmp_path, rng):
+    """The sown "losses" collection is transient output: it is excluded from
+    checkpoints (so adding/removing sown losses never invalidates old
+    checkpoints) and the live collection survives a load()."""
+    s, x = _collapsed_stoke(aux_loss_weight=1.0)
+    y = np.zeros((4,), np.int32)
+    for _ in range(3):
+        s.train_step(x, y)
+    path = str(tmp_path / "ckpt")
+    tag_dir = s.save(path)
+    # the saved variables payload carries params only — no aux-loss leaves
+    import os
+
+    data = np.load(os.path.join(tag_dir, "variables.npz"))
+    n_param_leaves = len(jax.tree_util.tree_leaves(s.params))
+    assert len(data.files) == n_param_leaves
+
+    s2, _ = _collapsed_stoke(aux_loss_weight=1.0)
+    s2.load(path)
+    assert s2.optimizer_steps == 3
+    assert s2.aux_losses is not None  # live collection re-attached
+    np.testing.assert_allclose(
+        np.asarray(s2.params["moe"]["router"]["kernel"]),
+        np.asarray(s.params["moe"]["router"]["kernel"]),
+        rtol=1e-6,
+    )
+    # and training continues cleanly after the restore
+    s2.train_step(x, y)
+    assert s2.optimizer_steps == 4

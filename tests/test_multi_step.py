@@ -197,3 +197,56 @@ def test_train_steps_fp16_scaler_advances(devices, rng):
     s.train_steps(xs, (ys,))
     assert s.optimizer_steps == 2
     assert float(s.loss_scale) > 0
+
+
+def test_train_steps_chunked_matches_full(devices, rng):
+    """segment_size streams the segment in chunks: counters, params, EMA and
+    stacked reports must match the single-dispatch run exactly."""
+    grad_accum = 2
+    n_steps = 4
+    total = n_steps * grad_accum
+    xs = rng.normal(size=(total, 16, 32, 32, 3)).astype(np.float32)
+    ys = rng.integers(0, 10, size=(total, 16))
+
+    a = _make(devices, grad_accum)
+    ra = a.train_steps(xs, (ys,))
+
+    b = _make(devices, grad_accum)
+    rb = b.train_steps(xs, (ys,), segment_size=2)  # 2 chunks of 2 steps
+    assert b.optimizer_steps == a.optimizer_steps == n_steps
+    assert b.backward_steps == a.backward_steps == total
+    la, lb = jax.tree_util.tree_leaves(ra)[0], jax.tree_util.tree_leaves(rb)[0]
+    assert la.shape == lb.shape
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-5,
+                               atol=1e-7)
+    for pa, pb in zip(
+        jax.tree_util.tree_leaves(a.params), jax.tree_util.tree_leaves(b.params)
+    ):
+        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                                   rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(float(a.ema_loss), float(b.ema_loss), rtol=1e-5)
+
+    # a segment_size >= n is a no-op (single dispatch); invalid values raise
+    c = _make(devices, grad_accum)
+    c.train_steps(xs, (ys,), segment_size=99)
+    assert c.optimizer_steps == n_steps
+    with pytest.raises(ValueError, match="segment_size"):
+        c.train_steps(xs, (ys,), segment_size=0)
+
+
+def test_segment_memory_guard():
+    """The pre-flight guard raises an actionable error when the stacked
+    inputs alone exceed free device memory, and stays quiet otherwise."""
+    from stoke_tpu.facade import _check_segment_memory
+
+    # no stats (CPU simulator) -> no guard
+    _check_segment_memory(10**12, None)
+    # fits comfortably -> quiet
+    _check_segment_memory(
+        1_000, {"bytes_limit": 1_000_000, "bytes_in_use": 100_000}
+    )
+    # obviously too big -> actionable error naming segment_size
+    with pytest.raises(ValueError, match="segment_size"):
+        _check_segment_memory(
+            950_000, {"bytes_limit": 1_000_000, "bytes_in_use": 500_000}
+        )

@@ -128,6 +128,14 @@ def test_sharded_save_multiprocess(tmp_path):
     run_workers("sharded_save", str(tmp_path))
 
 
+def test_async_sharded_save_multiprocess(tmp_path):
+    """Multi-host ASYNC sharded save (orbax AsyncCheckpointer): training
+    continues during the background write, meta.json appears only after the
+    cross-process commit, and the load round-trips exactly (round-3 lift of
+    the async_save single-process restriction)."""
+    run_workers("async_sharded_save", str(tmp_path))
+
+
 def test_loader_sampler_enforcement_and_sharding(tmp_path):
     """Sampler required multi-process; shards are disjoint and cover all."""
     run_workers("loader", str(tmp_path))

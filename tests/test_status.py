@@ -235,19 +235,23 @@ def test_validation_messages_name_the_axis():
         )
 
 
-def test_tensorboard_config_requires_torch(monkeypatch):
-    """TensorboardConfig checks torch.utils.tensorboard importability at
-    init (VERDICT r1 weak #2)."""
-    import sys
-
+def test_tensorboard_config_validates_output_path(tmp_path):
+    """TensorboardConfig validates the output path is creatable at init
+    (round 3: metrics use the in-repo native event writer — no torch
+    dependency to check anymore, but path failures must still surface at
+    init, not at the first mid-training log call)."""
     from stoke_tpu import TensorboardConfig
 
-    # torch IS available in this environment: passes
-    StokeStatus(batch_size_per_device=8, configs=[TensorboardConfig()])
-    # simulate it missing: None in sys.modules makes the import raise
-    monkeypatch.setitem(sys.modules, "torch.utils.tensorboard", None)
-    with pytest.raises(StokeValidationError, match="tensorboard"):
-        StokeStatus(batch_size_per_device=8, configs=[TensorboardConfig()])
+    # a creatable path passes (and is created eagerly)
+    ok = TensorboardConfig(output_path=str(tmp_path / "tb"))
+    StokeStatus(batch_size_per_device=8, configs=[ok])
+    assert (tmp_path / "tb").exists()
+    # an impossible path (a FILE in the way) fails at init
+    blocker = tmp_path / "blocked"
+    blocker.write_text("not a directory")
+    bad = TensorboardConfig(output_path=str(blocker))
+    with pytest.raises(StokeValidationError, match="not creatable"):
+        StokeStatus(batch_size_per_device=8, configs=[bad])
 
 
 def test_reference_aliases():
