@@ -29,7 +29,18 @@ from _supervise import supervise  # noqa: E402
 
 
 def main():
-    if "--_worker" not in sys.argv:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--gpt-size", default="base",
+                    choices=["none", "tiny", "mini", "small", "medium",
+                             "base", "large"],
+                    help="compute-dense GPT phase size ('none' skips it)")
+    ap.add_argument("--gpt-len", type=int, default=1024)
+    ap.add_argument("--gpt-batch", type=int, default=8)
+    args = ap.parse_args()
+    if not args._worker:
         sys.exit(supervise(__file__, sys.argv[1:]))
 
     import jax
@@ -106,6 +117,54 @@ def main():
         rec["achieved_tflops"] = round(ach, 2)
         rec["fraction_of_matmul_peak"] = round(ach / peak_tflops, 4)
     print(json.dumps(rec), flush=True)
+    del stoke, xs, ys
+
+    # 5. compute-dense ceiling: GPT with MXU-shaped matmuls (hidden-width
+    # GEMMs at seq 1k).  If THIS hits a healthy fraction of the measured
+    # matmul peak while the 32x32 ResNet does not, the ResNet gap is
+    # conv-shape utilization, not framework overhead — the round-2 gap
+    # analysis keystone (BENCH_NOTES.md), now measured instead of argued.
+    if args.gpt_size != "none":
+        from stoke_tpu.models import causal_lm_loss
+        from stoke_tpu.models.gpt import GPT
+
+        L, gb, GSEG = args.gpt_len, args.gpt_batch, 4
+        gpt = GPT(vocab_size=32768, size_name=args.gpt_size, max_len=L,
+                  dropout_rate=0.0)
+        gvars = init_module(
+            gpt, jax.random.PRNGKey(0), np.zeros((2, L), np.int32),
+            train=False,
+        )
+        gstoke = Stoke(
+            model=gpt,
+            optimizer=StokeOptimizer(
+                optimizer=optax.adamw,
+                optimizer_kwargs={"learning_rate": 3e-4},
+            ),
+            loss=causal_lm_loss,
+            params=gvars,
+            batch_size_per_device=gb,
+            device="tpu" if jax.default_backend() != "cpu" else "cpu",
+            precision="bf16",
+            model_train_kwargs={"train": True},
+            model_eval_kwargs={"train": False},
+            verbose=False,
+        )
+        ids1 = jax.device_put(
+            r.integers(0, 32768, size=(gb, L)).astype(np.int32))
+        g_flops = gstoke.estimate_step_flops(ids1, (ids1,))
+        gids = jax.device_put(
+            r.integers(0, 32768, size=(GSEG, gb, L)).astype(np.int32))
+        t_g = delta_time(lambda: gstoke.train_steps(gids, (gids,)), 3)
+        grec = {"probe": "gpt_dense", "size": args.gpt_size, "L": L,
+                "batch": gb,
+                "step_ms": round(t_g / GSEG * 1e3, 2),
+                "tok_per_sec": round(gb * L * GSEG / t_g, 1)}
+        if g_flops:
+            ach = g_flops / (t_g / GSEG) / 1e12
+            grec["achieved_tflops"] = round(ach, 2)
+            grec["mfu_vs_matmul_peak"] = round(ach / peak_tflops, 4)
+        print(json.dumps(grec), flush=True)
 
 
 if __name__ == "__main__":

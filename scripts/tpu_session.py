@@ -30,12 +30,17 @@ QUEUE = {
                  ["--model", "resnet18", "--epochs", "120", "--augment",
                   "--skip-overfit"]),
     "longcontext": ("scripts/bench_longcontext.py", []),
+    # composed-path rows (VERDICT r3 item 4): flash vs dense ring hop math;
+    # on one chip the ring degenerates to a single hop — the dense arm OOMs
+    # at 8k while flash runs, which is the comparison that matters there
+    "op_ring": ("scripts/bench_longcontext.py",
+                ["--op-ring", "--lengths", "1024,4096,8192", "--batch", "4"]),
     "bench": ("bench.py", []),
     # CPU-safe smoke of the runpy dispatch itself (not part of the default
     # queue): tiny preset, finishes in ~1 min off-chip
     "smoke": ("bench.py", ["--preset", "tiny"]),
 }
-DEFAULT_QUEUE = ("flops_probe", "accuracy", "longcontext", "bench")
+DEFAULT_QUEUE = ("flops_probe", "accuracy", "longcontext", "op_ring", "bench")
 
 
 def main():
@@ -45,7 +50,22 @@ def main():
                     help="comma-separated subset of: " + ", ".join(QUEUE))
     args = ap.parse_args()
     if not args._worker:
-        sys.exit(supervise(__file__, sys.argv[1:], watchdog_seconds=5400))
+        # pause any background tunnel watcher while the session holds the
+        # (single-client) tunnel
+        lock = "/tmp/tpu_in_use"
+        try:
+            with open(lock, "w") as f:
+                f.write(str(os.getpid()))
+        except OSError:
+            lock = None
+        try:
+            sys.exit(supervise(__file__, sys.argv[1:], watchdog_seconds=5400))
+        finally:
+            if lock:
+                try:
+                    os.remove(lock)
+                except OSError:
+                    pass
 
     root = os.path.dirname(HERE)
     failures = 0

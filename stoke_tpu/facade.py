@@ -1020,7 +1020,12 @@ class Stoke:
                         "Stoke -- train_steps() leaves disagree on the "
                         "number of stacked micro-batches"
                     )
-                seg_bytes += getattr(leaf, "nbytes", 0)
+                # the memory guard estimates the upcoming host->device
+                # transfer: leaves that are already jax Arrays are resident
+                # (counted in the device's bytes_in_use) — counting them
+                # again would double-bill pre-placed segments
+                if not isinstance(leaf, jax.Array):
+                    seg_bytes += getattr(leaf, "nbytes", 0)
         if not n:
             raise ValueError(
                 "Stoke -- train_steps() found no stacked array leaves"
@@ -1492,19 +1497,33 @@ class Stoke:
         vars_like = {
             k: v for k, v in self._variables.items() if k != "losses"
         }
-        payload = io_ops.load_checkpoint(
-            path=path,
-            tag=tag,
-            variables_like=vars_like,
-            opt_state_like=opt_like,
-            scaler_like=self._scaler_state,
-            config=self._status_obj.checkpoint_config,
-            name=name if tag is None else None,
-            grad_buf_like=self._grad_buf,
-        )
-        loaded_vars = payload["variables"]
-        if "losses" in self._variables:
-            loaded_vars = {**loaded_vars, "losses": self._variables["losses"]}
+
+        def _load(like):
+            return io_ops.load_checkpoint(
+                path=path,
+                tag=tag,
+                variables_like=like,
+                opt_state_like=opt_like,
+                scaler_like=self._scaler_state,
+                config=self._status_obj.checkpoint_config,
+                name=name if tag is None else None,
+                grad_buf_like=self._grad_buf,
+            )
+
+        try:
+            payload = _load(vars_like)
+            loaded_vars = payload["variables"]
+            if "losses" in self._variables:
+                loaded_vars = {
+                    **loaded_vars, "losses": self._variables["losses"]
+                }
+        except ValueError:
+            if "losses" not in self._variables:
+                raise
+            # legacy checkpoint that DID include the sown collection (saved
+            # before losses were excluded): retry with the full template
+            payload = _load(self._variables)
+            loaded_vars = payload["variables"]
         self._variables = loaded_vars
         self._opt_commit(payload["opt_state"])
         self._scaler_state = payload["scaler_state"]

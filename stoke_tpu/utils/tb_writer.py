@@ -58,6 +58,10 @@ def _masked_crc(data: bytes) -> int:
 
 
 def _varint(n: int) -> bytes:
+    # proto int64 convention: negatives encode as the 64-bit two's
+    # complement (10-byte varint) — without the mask a negative n would
+    # loop forever (-1 >> 7 == -1 in Python)
+    n &= (1 << 64) - 1
     out = bytearray()
     while True:
         bits = n & 0x7F

@@ -445,3 +445,37 @@ def test_moe_checkpoint_excludes_transient_losses(tmp_path, rng):
     # and training continues cleanly after the restore
     s2.train_step(x, y)
     assert s2.optimizer_steps == 4
+
+
+def test_legacy_checkpoint_with_losses_collection_loads(tmp_path, rng):
+    """A checkpoint saved when the sown 'losses' collection was still
+    included in variables (pre-exclusion versions) loads via the fallback
+    full-template retry."""
+    from stoke_tpu import io_ops
+
+    s, x = _collapsed_stoke(aux_loss_weight=1.0)
+    y = np.zeros((4,), np.int32)
+    s.train_step(x, y)
+    # simulate the legacy layout: save WITH the losses collection included
+    io_ops.save_checkpoint(
+        path=str(tmp_path / "legacy"),
+        name="stoke",
+        variables=s._variables,  # includes "losses"
+        opt_state=s.opt_state,
+        scaler_state=s.scaler,
+        counters={"backward_step": 1, "grad_accum_step": 0,
+                  "optimizer_step": 1},
+        status=s._status_obj.to_dict(),
+        extras=None,
+        config=s._status_obj.checkpoint_config,
+        backward_step=1,
+    )
+    s2, _ = _collapsed_stoke(aux_loss_weight=1.0)
+    s2.load(str(tmp_path / "legacy"))
+    assert s2.optimizer_steps == 1
+    np.testing.assert_allclose(
+        np.asarray(s2.params["moe"]["router"]["kernel"]),
+        np.asarray(s.params["moe"]["router"]["kernel"]),
+        rtol=1e-6,
+    )
+    s2.train_step(x, y)  # training continues with a stable state structure

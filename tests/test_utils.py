@@ -196,3 +196,16 @@ def test_tb_writer_detects_corruption(tmp_path):
     open(w.path, "wb").write(bytes(data))
     with pytest.raises(ValueError, match="corrupt"):
         read_scalar_events(w.path)
+
+
+def test_tb_writer_negative_step(tmp_path):
+    """Negative steps encode as 64-bit two's-complement varints (proto
+    int64 convention) instead of hanging the encoder."""
+    from stoke_tpu.utils.tb_writer import TBEventWriter, read_scalar_events
+
+    w = TBEventWriter(str(tmp_path))
+    w.add_scalar("x", 2.5, -1)
+    w.close()
+    (tag, val, step) = read_scalar_events(w.path)[0]
+    assert tag == "x" and val == 2.5
+    assert step == (1 << 64) - 1  # the raw two's-complement encoding
