@@ -190,19 +190,8 @@ def _supervise(argv, preset: str, requested: dict | None = None) -> int:
                 if "metric" not in parsed:
                     continue
                 if parsed.get("on_accelerator") and parsed.get("value", 0) > 0:
-                    _persist_result(
-                        parsed["metric"],
-                        {
-                            "value": parsed["value"],
-                            "unit": parsed["unit"],
-                            "vs_baseline": parsed["vs_baseline"],
-                            "date": time.strftime("%Y-%m-%d"),
-                            "api": parsed.get("api"),
-                            "batch": parsed.get("batch"),
-                            "steps_per_dispatch": parsed.get("steps_per_dispatch"),
-                            "source": "bench.py fresh capture",
-                        },
-                    )
+                    # the worker already persisted its own record (single
+                    # source of truth for the BENCH_RESULTS.json schema)
                     print(line)
                     return 0
                 # Headline measurement ran but on CPU (tunnel handed back no

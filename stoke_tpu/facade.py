@@ -59,6 +59,17 @@ from stoke_tpu.utils.trees import tree_count_params
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 
+def _on_accelerator(leaf) -> bool:
+    """True when ``leaf`` is a jax Array resident on a non-CPU device (its
+    bytes are already in the accelerator's ``bytes_in_use``)."""
+    if not isinstance(leaf, jax.Array):
+        return False
+    try:
+        return all(d.platform != "cpu" for d in leaf.sharding.device_set)
+    except Exception:
+        return False
+
+
 def _device_memory_stats() -> Optional[dict]:
     """Memory stats of the first local device, or None where the backend
     doesn't report them (CPU simulator)."""
@@ -1021,10 +1032,12 @@ class Stoke:
                         "number of stacked micro-batches"
                     )
                 # the memory guard estimates the upcoming host->device
-                # transfer: leaves that are already jax Arrays are resident
-                # (counted in the device's bytes_in_use) — counting them
-                # again would double-bill pre-placed segments
-                if not isinstance(leaf, jax.Array):
+                # transfer: arrays already resident on an accelerator are
+                # counted in the device's bytes_in_use (double-billing
+                # them would spuriously trip the guard), while host-side
+                # data — numpy OR jax Arrays committed to a CPU device —
+                # still has to cross the wire and counts
+                if not _on_accelerator(leaf):
                     seg_bytes += getattr(leaf, "nbytes", 0)
         if not n:
             raise ValueError(
@@ -1517,11 +1530,16 @@ class Stoke:
                 loaded_vars = {
                     **loaded_vars, "losses": self._variables["losses"]
                 }
-        except ValueError:
-            if "losses" not in self._variables:
+        except ValueError as e:
+            # retry ONLY the specific legacy layout (checkpoint saved
+            # before sown losses were excluded → leaf-count mismatch on
+            # the variables tree); any other ValueError is a genuine
+            # incompatibility the user must see verbatim
+            if (
+                "losses" not in self._variables
+                or "checkpoint variables has" not in str(e)
+            ):
                 raise
-            # legacy checkpoint that DID include the sown collection (saved
-            # before losses were excluded): retry with the full template
             payload = _load(self._variables)
             loaded_vars = payload["variables"]
         self._variables = loaded_vars

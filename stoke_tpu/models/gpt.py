@@ -57,6 +57,10 @@ class GPT(nn.Module):
     moe_capacity_factor: float = 1.25
     moe_router_noise: float = 0.0  # needs the "router" rng stream when > 0
     moe_top_k: int = 1  # experts per token (1 = Switch, 2 = GShard-style)
+    # return (hidden, embedding) instead of logits so the loss can run
+    # chunked over the sequence (ops/chunked_ce.py) — the [B, L, V] logits
+    # tensor is never materialized; requires tie_embeddings
+    chunked_head: bool = False
 
     @nn.compact
     def __call__(self, input_ids, train: bool = True):
@@ -115,6 +119,13 @@ class GPT(nn.Module):
                     self.attention_fn, name=f"layer_{i}",
                 )(h, bias, not train)
         h = nn.LayerNorm(epsilon=1e-5, name="ln_final")(h)
+        if self.chunked_head:
+            if not self.tie_embeddings:
+                raise ValueError(
+                    "GPT: chunked_head requires tie_embeddings=True (the "
+                    "chunked loss re-applies the tied embedding per chunk)"
+                )
+            return h, tok_emb.embedding
         if self.tie_embeddings:
             return tok_emb.attend(h)
         return nn.Dense(self.vocab_size, name="lm_head")(h)

@@ -10,6 +10,10 @@ from stoke_tpu.ops.attention import (
     ring_attention,
     ulysses_attention,
 )
+from stoke_tpu.ops.chunked_ce import (
+    chunked_causal_lm_loss,
+    chunked_softmax_cross_entropy,
+)
 from stoke_tpu.ops.flash_attention import flash_attention, make_flash_attention
 
 __all__ = [
@@ -19,4 +23,6 @@ __all__ = [
     "ulysses_attention",
     "flash_attention",
     "make_flash_attention",
+    "chunked_softmax_cross_entropy",
+    "chunked_causal_lm_loss",
 ]

@@ -1,0 +1,98 @@
+"""Chunked LM-head cross entropy: loss without the [B, L, V] logits tensor.
+
+At real LM scale the other long-context memory cliff (besides attention) is
+the output head: materializing logits costs B·L·V activations — at L=8k,
+V=50k, bf16 that is ~0.8 GB per sample *before* the softmax residuals.  The
+reference has no equivalent (it ships no models, SURVEY.md §2.7).
+
+TPU-idiomatic fix: ``lax.scan`` over sequence chunks with rematerialization.
+Each step computes the chunk's logits on the MXU ([B, c, H] × [V, H]),
+reduces them to cross-entropy sums, and drops them; ``jax.checkpoint``
+around the scan body keeps the backward residuals to the chunk inputs, so
+peak live logits memory is O(B·chunk·V) for forward AND backward — L/chunk
+times smaller — while the per-chunk GEMMs stay MXU-sized.
+
+Pairs with ``GPT(chunked_head=True)``, which returns ``(hidden, embedding)``
+instead of logits; :func:`chunked_causal_lm_loss` is the drop-in loss for
+that output (same semantics as ``models.gpt.causal_lm_loss``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def chunked_softmax_cross_entropy(
+    hidden, emb, targets, *, chunk: int = 128, mask=None,
+):
+    """Masked-mean token cross entropy from hidden states and an embedding.
+
+    Args:
+        hidden: [B, L, H] final hidden states.
+        emb: [V, H] (tied) output embedding matrix.
+        targets: [B, L] int target ids.
+        chunk: sequence positions per scan step (per-step logits live
+            memory is B·chunk·V floats).
+        mask: optional [B, L] 0/1 validity; masked positions contribute
+            neither loss nor count.
+
+    Returns the scalar mean CE over valid positions — identical numerics to
+    ``optax.softmax_cross_entropy_with_integer_labels`` over full logits
+    (fp32 accumulation), tested in tests/test_models.py.
+    """
+    import optax
+
+    B, L, H = hidden.shape
+    if mask is None:
+        mask = jnp.ones((B, L), jnp.float32)
+    mask = mask.astype(jnp.float32)
+    chunk = max(1, min(int(chunk), L))
+    pad = (-L) % chunk
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    nc = (L + pad) // chunk
+    hs = hidden.reshape(B, nc, chunk, H).transpose(1, 0, 2, 3)
+    ts = targets.reshape(B, nc, chunk).transpose(1, 0, 2)
+    ms = mask.reshape(B, nc, chunk).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def body(carry, xs):
+        h_c, t_c, m_c = xs
+        # keep the GEMM in the inputs' dtype (bf16 multiply / fp32
+        # accumulate on the MXU) — an explicit fp32 upcast would run the
+        # hot matmul as full fp32, several times slower on TPU for no
+        # accuracy gain over fp32 accumulation
+        logits = jnp.einsum(
+            "bch,vh->bcv", h_c, emb,
+            preferred_element_type=jnp.float32,
+        )
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, t_c)
+        tot, cnt = carry
+        return (tot + jnp.sum(ce * m_c), cnt + jnp.sum(m_c)), None
+
+    (tot, cnt), _ = lax.scan(
+        body, (jnp.float32(0.0), jnp.float32(0.0)), (hs, ts, ms)
+    )
+    return tot / jnp.maximum(cnt, 1.0)
+
+
+def chunked_causal_lm_loss(out, input_ids, mask=None, *, chunk: int = 128):
+    """Next-token CE for ``GPT(chunked_head=True)`` outputs.
+
+    ``out`` is the model's ``(hidden, embedding)`` pair; semantics match
+    ``models.gpt.causal_lm_loss`` on full logits (predict t+1 from ≤ t,
+    optional [B, L] padding mask) without materializing them.
+    """
+    hidden, emb = out
+    targets = input_ids[:, 1:]
+    hidden = hidden[:, :-1]
+    m = None if mask is None else mask[:, 1:]
+    return chunked_softmax_cross_entropy(
+        hidden, emb, targets, chunk=chunk, mask=m
+    )

@@ -236,3 +236,93 @@ def test_bert_trains_through_facade_with_pld(rng):
     for _ in range(3):
         s.train_step((ids, mask), y)
     assert s.optimizer_steps == 3
+
+
+# ---------------------- chunked LM-head cross entropy ---------------------- #
+
+
+def test_chunked_ce_matches_full(rng):
+    """Chunked CE (scan over sequence chunks, remat) must match full-logits
+    CE in values AND gradients (wrt hidden and embedding), including a
+    non-divisible L and a padding mask."""
+    import optax
+
+    from stoke_tpu.ops import chunked_softmax_cross_entropy
+
+    B, L, H, V = 2, 37, 16, 50  # L deliberately not a multiple of chunk
+    hidden = jnp.asarray(rng.normal(size=(B, L, H)).astype(np.float32))
+    emb = jnp.asarray(rng.normal(size=(V, H)).astype(np.float32) * 0.3)
+    targets = jnp.asarray(rng.integers(0, V, size=(B, L)))
+    m = np.ones((B, L), np.int32)
+    m[0, 30:] = 0
+    mask = jnp.asarray(m)
+
+    def full(hidden, emb):
+        logits = jnp.einsum("blh,vh->blv", hidden, emb)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        w = mask.astype(ce.dtype)
+        return (ce * w).sum() / w.sum()
+
+    def chunked(hidden, emb):
+        return chunked_softmax_cross_entropy(
+            hidden, emb, targets, chunk=8, mask=mask
+        )
+
+    np.testing.assert_allclose(
+        float(chunked(hidden, emb)), float(full(hidden, emb)), rtol=1e-6
+    )
+    gc = jax.grad(chunked, argnums=(0, 1))(hidden, emb)
+    gf = jax.grad(full, argnums=(0, 1))(hidden, emb)
+    for a, b in zip(gc, gf):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_gpt_chunked_head_matches_and_trains(rng):
+    """GPT(chunked_head=True) + chunked_causal_lm_loss equals the full-logits
+    causal_lm_loss and trains through the facade."""
+    import optax
+
+    from stoke_tpu import Stoke, StokeOptimizer
+    from stoke_tpu.models import GPT, causal_lm_loss
+    from stoke_tpu.ops import chunked_causal_lm_loss
+
+    seq = rng.integers(1, 64, size=(4, 24)).astype(np.int32)
+    full_model = GPT(vocab_size=64, size_name="tiny", max_len=32,
+                     dropout_rate=0.0)
+    v = init_module(full_model, jax.random.PRNGKey(0), seq, train=False)
+    chunk_model = GPT(vocab_size=64, size_name="tiny", max_len=32,
+                      dropout_rate=0.0, chunked_head=True)
+    # identical params: chunked_head only changes the output contract
+    lf = float(causal_lm_loss(full_model.apply(v, seq, train=False), seq))
+    lc = float(chunked_causal_lm_loss(
+        chunk_model.apply(v, seq, train=False), seq, chunk=8))
+    np.testing.assert_allclose(lc, lf, rtol=1e-5)
+
+    s = Stoke(
+        model=chunk_model,
+        optimizer=StokeOptimizer(
+            optimizer=optax.adam, optimizer_kwargs={"learning_rate": 3e-3}
+        ),
+        loss=lambda out, ids: chunked_causal_lm_loss(out, ids, chunk=8),
+        params=v,
+        batch_size_per_device=4,
+        device="cpu",
+        verbose=False,
+    )
+    l0 = float(s.train_step(seq, (seq,)))
+    for _ in range(10):
+        l = float(s.train_step(seq, (seq,)))
+    assert l < l0
+
+
+def test_gpt_chunked_head_requires_tied(rng):
+    from stoke_tpu.models import GPT
+
+    with pytest.raises(ValueError, match="tie_embeddings"):
+        init_module(
+            GPT(vocab_size=16, size_name="tiny", tie_embeddings=False,
+                chunked_head=True),
+            jax.random.PRNGKey(0), np.ones((1, 8), np.int32), train=False,
+        )
