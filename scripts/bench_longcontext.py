@@ -26,7 +26,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from _supervise import supervise  # noqa: E402
 
 
-def build(size, L, batch, attention):
+def build(size, L, batch, attention, vocab=2048, chunked_ce=False):
     import jax
     import optax
 
@@ -40,7 +40,19 @@ def build(size, L, batch, attention):
 
         kwargs.update(attention_fn=make_flash_attention(causal=True),
                       attention_is_causal=True)
-    model = GPT(vocab_size=2048, size_name=size, max_len=L,
+    if chunked_ce:
+        # chunked LM-head CE: the [B, L, V] logits tensor is never
+        # materialized (ops/chunked_ce.py) — the second long-context
+        # memory cliff, composable with the flash kernel
+        from stoke_tpu.ops import chunked_causal_lm_loss
+
+        kwargs.update(chunked_head=True)
+        loss = lambda out, labels: chunked_causal_lm_loss(out, labels)
+    else:
+        from stoke_tpu.models.gpt import causal_lm_loss
+
+        loss = causal_lm_loss
+    model = GPT(vocab_size=vocab, size_name=size, max_len=L,
                 dropout_rate=0.0, **kwargs)
     ids = np.zeros((2, L), np.int32)
     variables = init_module(model, jax.random.PRNGKey(0), ids, train=False)
@@ -49,8 +61,7 @@ def build(size, L, batch, attention):
         model=model,
         optimizer=StokeOptimizer(
             optimizer=optax.adamw, optimizer_kwargs={"learning_rate": 3e-4}),
-        loss=lambda logits, labels: optax.softmax_cross_entropy_with_integer_labels(
-            logits[:, :-1], labels[:, 1:]).mean(),
+        loss=loss,
         params=variables,
         batch_size_per_device=batch,
         device="tpu" if on_accel else "cpu",
@@ -131,6 +142,10 @@ def main():
                     "instead of the model-level GPT sweep")
     ap.add_argument("--heads", type=int, default=8)
     ap.add_argument("--head-dim", type=int, default=64)
+    ap.add_argument("--vocab", type=int, default=2048)
+    ap.add_argument("--chunked-ce", action="store_true",
+                    help="add a third arm: flash attention + chunked LM-head "
+                    "CE (no [B, L, V] logits tensor)")
     args = ap.parse_args()
     if not args._worker:
         sys.exit(supervise(__file__, sys.argv[1:], watchdog_seconds=3000))
@@ -148,21 +163,29 @@ def main():
 
     r = np.random.default_rng(0)
     results = []
+    arms = [("dense", False), ("flash", False)]
+    if args.chunked_ce:
+        arms.append(("flash", True))
     for L in (int(x) for x in args.lengths.split(",")):
-        ids = jax.device_put(r.integers(0, 2048, size=(args.batch, L)).astype(np.int32))
-        for attention in ("dense", "flash"):
+        ids = jax.device_put(
+            r.integers(0, args.vocab, size=(args.batch, L)).astype(np.int32))
+        for attention, chunked in arms:
+            label = attention + ("+chunked_ce" if chunked else "")
             stoke = None
             try:
-                stoke = build(args.size, L, args.batch, attention)
+                stoke = build(args.size, L, args.batch, attention,
+                              vocab=args.vocab, chunked_ce=chunked)
                 t = delta_time(lambda: stoke.train_step(ids, (ids,)), 5)
                 tok_s = args.batch * L / t
                 rec = {"bench": "gpt_longcontext", "size": args.size,
-                       "L": L, "batch": args.batch, "attention": attention,
+                       "L": L, "batch": args.batch, "attention": label,
+                       "vocab": args.vocab,
                        "step_ms": round(t * 1e3, 2),
                        "tok_per_sec": round(tok_s, 1)}
             except Exception as e:
                 rec = {"bench": "gpt_longcontext", "size": args.size, "L": L,
-                       "batch": args.batch, "attention": attention,
+                       "batch": args.batch, "attention": label,
+                       "vocab": args.vocab,
                        "error": type(e).__name__}
             finally:
                 # drop device state even when the step OOMs, or the dead

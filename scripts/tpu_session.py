@@ -35,12 +35,18 @@ QUEUE = {
     # at 8k while flash runs, which is the comparison that matters there
     "op_ring": ("scripts/bench_longcontext.py",
                 ["--op-ring", "--lengths", "1024,4096,8192", "--batch", "4"]),
+    # realistic-vocab arm: at V=32k the [B, L, V] logits tensor is the
+    # memory cliff; the flash+chunked_ce arm drops it (ops/chunked_ce.py)
+    "chunked_ce": ("scripts/bench_longcontext.py",
+                   ["--chunked-ce", "--vocab", "32768",
+                    "--lengths", "4096,8192", "--batch", "2"]),
     "bench": ("bench.py", []),
     # CPU-safe smoke of the runpy dispatch itself (not part of the default
     # queue): tiny preset, finishes in ~1 min off-chip
     "smoke": ("bench.py", ["--preset", "tiny"]),
 }
-DEFAULT_QUEUE = ("flops_probe", "accuracy", "longcontext", "op_ring", "bench")
+DEFAULT_QUEUE = ("flops_probe", "accuracy", "longcontext", "op_ring",
+                 "chunked_ce", "bench")
 
 
 def main():

@@ -1530,17 +1530,18 @@ class Stoke:
                 loaded_vars = {
                     **loaded_vars, "losses": self._variables["losses"]
                 }
-        except ValueError as e:
-            # retry ONLY the specific legacy layout (checkpoint saved
-            # before sown losses were excluded → leaf-count mismatch on
-            # the variables tree); any other ValueError is a genuine
-            # incompatibility the user must see verbatim
-            if (
-                "losses" not in self._variables
-                or "checkpoint variables has" not in str(e)
-            ):
+        except ValueError as first_err:
+            # legacy layout: a checkpoint saved before sown losses were
+            # excluded mismatches the stripped template (consolidated:
+            # leaf-count error; sharded: orbax structure error).  Retry
+            # with the full template — but if that fails too, surface the
+            # ORIGINAL error (a genuine incompatibility), not the retry's
+            if "losses" not in self._variables:
                 raise
-            payload = _load(self._variables)
+            try:
+                payload = _load(self._variables)
+            except ValueError:
+                raise first_err
             loaded_vars = payload["variables"]
         self._variables = loaded_vars
         self._opt_commit(payload["opt_state"])

@@ -19,8 +19,6 @@ that output (same semantics as ``models.gpt.causal_lm_loss``).
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 from jax import lax
