@@ -5,10 +5,13 @@ only the data-level BucketedDistributedSampler; these ops are capability
 upside of the TPU build, designed in from the start."""
 
 from stoke_tpu.ops.attention import (
+    inverse_permutation,
     make_ring_attention,
     make_ulysses_attention,
     ring_attention,
     ulysses_attention,
+    zigzag_permutation,
+    zigzag_ring_attention,
 )
 from stoke_tpu.ops.chunked_ce import (
     chunked_causal_lm_loss,
@@ -25,4 +28,7 @@ __all__ = [
     "make_flash_attention",
     "chunked_softmax_cross_entropy",
     "chunked_causal_lm_loss",
+    "zigzag_ring_attention",
+    "zigzag_permutation",
+    "inverse_permutation",
 ]

@@ -164,6 +164,45 @@ def _ring_shard(q, k, v, kmask, *, axis_name, causal, scale):
     return (o / safe_l[..., None]).astype(q.dtype)
 
 
+def _rotate_kv(k, v, km, axis_name, perm):
+    """One ring hop: pass K/V (and the rotating key mask) to the neighbor."""
+    k = lax.ppermute(k, axis_name, perm)
+    v = lax.ppermute(v, axis_name, perm)
+    if km is not None:
+        km = lax.ppermute(km, axis_name, perm)
+    return k, v, km
+
+
+def _seq_shard_map(body, mesh, qkv_spec, mask_spec, q, k, v, kmask):
+    """Dispatch a per-shard attention body through shard_map with the
+    standard (q, k, v[, kmask]) signature (kmask=None drops the operand)."""
+    if kmask is None:
+        fn = shard_map(
+            lambda q, k, v: body(q, k, v, None),
+            mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec), out_specs=qkv_spec,
+        )
+        return fn(q, k, v)
+    fn = shard_map(
+        lambda q, k, v, km: body(q, k, v, km),
+        mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec,
+    )
+    return fn(q, k, v, kmask)
+
+
+def _lse_merge(o, lse, o_hop, lse_hop):
+    """Log-sum-exp merge of two partial attentions.  The finite ``-NEG_INF``
+    sentinel keeps every term finite (fully-masked hops get weight
+    ``exp(-huge) == 0.0`` exactly)."""
+    lse_new = jnp.logaddexp(lse, lse_hop)
+    o_new = (
+        o * jnp.exp(lse - lse_new)[..., None]
+        + o_hop.astype(jnp.float32) * jnp.exp(lse_hop - lse_new)[..., None]
+    )
+    return o_new, lse_new
+
+
 def _ring_shard_flash(q, k, v, kmask, *, axis_name, causal, size):
     """Per-shard ring attention with the Pallas flash kernel as the hop math.
 
@@ -187,23 +226,7 @@ def _ring_shard_flash(q, k, v, kmask, *, axis_name, causal, size):
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
     perm = [(i, (i + 1) % size) for i in range(size)]
-
-    def merge(o, lse, o_hop, lse_hop):
-        # the -NEG_INF sentinel is finite so every term stays finite
-        # (masked hops get weight exp(-huge) == 0.0 exactly)
-        lse_new = jnp.logaddexp(lse, lse_hop)
-        o_new = (
-            o * jnp.exp(lse - lse_new)[..., None]
-            + o_hop.astype(jnp.float32) * jnp.exp(lse_hop - lse_new)[..., None]
-        )
-        return o_new, lse_new
-
-    def rotate(k, v, km):
-        k = lax.ppermute(k, axis_name, perm)
-        v = lax.ppermute(v, axis_name, perm)
-        if km is not None:
-            km = lax.ppermute(km, axis_name, perm)
-        return k, v, km
+    merge = _lse_merge
 
     # hop 0: diagonal block, static causal flag
     o_hop, lse_hop = flash_attention(
@@ -214,7 +237,7 @@ def _ring_shard_flash(q, k, v, kmask, *, axis_name, causal, size):
 
     def body(step, carry):
         o, lse, k, v, km = carry
-        k, v, km = rotate(k, v, km)
+        k, v, km = _rotate_kv(k, v, km, axis_name, perm)
         hop_mask = km
         if causal:
             valid = (step <= my_idx).astype(jnp.int32)
@@ -278,19 +301,165 @@ def ring_attention(
             causal=causal,
             scale=1.0 / (q.shape[-1] ** 0.5),
         )
-    if kmask is None:
-        fn = shard_map(
-            lambda q, k, v: body(q, k, v, None),
-            mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec), out_specs=qkv_spec,
+    return _seq_shard_map(body, mesh, qkv_spec, mask_spec, q, k, v, kmask)
+
+
+# --------------------------------------------------------------------------- #
+# zigzag ring attention (causal load balance)
+# --------------------------------------------------------------------------- #
+
+
+def zigzag_permutation(L: int, size: int):
+    """Index permutation mapping the natural sequence order to the zigzag
+    layout: with 2·size blocks of length L/(2·size), device d's shard is
+    ``concat(block_d, block_{2·size-1-d})``.  Apply with
+    ``x.take(perm, axis=seq_axis)``; invert with ``inverse_permutation``."""
+    if L % (2 * size):
+        raise ValueError(
+            f"zigzag layout needs L divisible by 2*axis_size = {2 * size}, "
+            f"got {L}"
         )
-        return fn(q, k, v)
-    fn = shard_map(
-        lambda q, k, v, km: body(q, k, v, km),
-        mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
-        out_specs=qkv_spec,
+    Lb = L // (2 * size)
+    import numpy as np
+
+    blocks = []
+    for d in range(size):
+        blocks.append(np.arange(d * Lb, (d + 1) * Lb))
+        hi = 2 * size - 1 - d
+        blocks.append(np.arange(hi * Lb, (hi + 1) * Lb))
+    return np.concatenate(blocks)
+
+
+def inverse_permutation(perm):
+    import numpy as np
+
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(len(perm))
+    return inv
+
+
+def _zigzag_shard(q, k, v, kmask, *, axis_name, size):
+    """Per-shard zigzag causal ring (flash inner).
+
+    The contiguous causal ring is load-IMBALANCED: at hop ``step`` only
+    devices with index ≥ step contribute, so half the hop FLOPs are masked
+    away on average.  In the zigzag layout device d holds sequence blocks
+    ``(d, 2n-1-d)`` — one early, one late — so every device does the same
+    causal work at every hop (the ring-flash-attention / striped-attention
+    balance trick).
+
+    Per hop the held K/V pair (two blocks) meets the resident Q pair:
+    block-level causality is whole-block (full / none) except the two
+    diagonal pairs of hop 0, which run as static causal-local flash calls.
+    Later hops are three square flash calls — q_lo x k_lo, q_hi x k_lo,
+    q_hi x k_hi — with traced whole-block validity masks; the fourth pair
+    (q_lo x k_hi) is STATICALLY invisible (a hi key block 2n-1-src >= n can
+    never precede a lo query block my <= n-1) and is skipped entirely.
+    """
+    my = lax.axis_index(axis_name)
+    B, H, Lq2, D = q.shape
+    Lb = Lq2 // 2
+    n = size
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    my_lo = my
+    my_hi = 2 * n - 1 - my
+
+    q_lo, q_hi = q[:, :, :Lb], q[:, :, Lb:]
+
+    def flash_lse(qh, kk, vv, mask, causal_flag):
+        return flash_attention(qh, kk, vv, mask, causal=causal_flag,
+                               return_lse=True)
+
+    # ---- hop 0: own blocks.  lo×lo and hi×hi are the causal diagonals;
+    # hi×lo is fully visible (my_hi > my_lo always); lo×hi contributes
+    # nothing.
+    k_lo, k_hi = k[:, :, :Lb], k[:, :, Lb:]
+    v_lo, v_hi = v[:, :, :Lb], v[:, :, Lb:]
+    m_lo = None if kmask is None else kmask[:, :Lb]
+    m_hi = None if kmask is None else kmask[:, Lb:]
+    o_lo, lse_lo = flash_lse(q_lo, k_lo, v_lo, m_lo, True)
+    o_hi, lse_hi = flash_lse(q_hi, k_hi, v_hi, m_hi, True)
+    o_hi = o_hi.astype(jnp.float32)
+    o_hi, lse_hi = _lse_merge(
+        o_hi, lse_hi, *flash_lse(q_hi, k_lo, v_lo, m_lo, False)
     )
-    return fn(q, k, v, kmask)
+    o_lo = o_lo.astype(jnp.float32)
+
+    # ---- hops 1..n-1: held blocks are (src, 2n-1-src); all visibility is
+    # whole-block (full or none — a traced scalar), so each (Q half,
+    # K half) pair is one square flash call whose key mask broadcasts the
+    # pair's validity (an invisible pair yields lse = -NEG_INF and the
+    # merge is an exact no-op).
+    def body(step, carry):
+        o_lo, lse_lo, o_hi, lse_hi, k, v, km = carry
+        k, v, km = _rotate_kv(k, v, km, axis_name, perm)
+        src = (my - step) % n
+        src_blks = (src, 2 * n - 1 - src)
+        k_halves = (k[:, :, :Lb], k[:, :, Lb:])
+        v_halves = (v[:, :, :Lb], v[:, :, Lb:])
+        km_halves = (None, None) if km is None else (km[:, :Lb], km[:, Lb:])
+
+        def pair(o, lse, qh, q_blk, half):
+            vis = (src_blks[half] < q_blk).astype(jnp.int32)
+            mask = jnp.broadcast_to(vis, (B, Lb))
+            if km_halves[half] is not None:
+                mask = mask * km_halves[half]
+            return _lse_merge(
+                o, lse,
+                *flash_lse(qh, k_halves[half], v_halves[half], mask, False),
+            )
+
+        # q_lo sees only lo key blocks (hi blocks are statically later)
+        o_lo, lse_lo = pair(o_lo, lse_lo, q_lo, my_lo, 0)
+        o_hi, lse_hi = pair(o_hi, lse_hi, q_hi, my_hi, 0)
+        o_hi, lse_hi = pair(o_hi, lse_hi, q_hi, my_hi, 1)
+        return o_lo, lse_lo, o_hi, lse_hi, k, v, km
+
+    if n > 1:
+        if kmask is not None:
+            o_lo, lse_lo, o_hi, lse_hi, *_ = lax.fori_loop(
+                1, n, body, (o_lo, lse_lo, o_hi, lse_hi, k, v, kmask)
+            )
+        else:
+            def body_nomask(step, carry):
+                o_lo, lse_lo, o_hi, lse_hi, k, v = carry
+                o_lo, lse_lo, o_hi, lse_hi, k2, v2, _ = body(
+                    step, (o_lo, lse_lo, o_hi, lse_hi, k, v, None)
+                )
+                return o_lo, lse_lo, o_hi, lse_hi, k2, v2
+
+            o_lo, lse_lo, o_hi, lse_hi, *_ = lax.fori_loop(
+                1, n, body_nomask, (o_lo, lse_lo, o_hi, lse_hi, k, v)
+            )
+    return jnp.concatenate([o_lo, o_hi], axis=2).astype(q.dtype)
+
+
+def zigzag_ring_attention(
+    q, k, v, kmask=None, *, mesh: Mesh, axis_name: str = "seq",
+    batch_axis: Optional[str] = "data",
+):
+    """Load-balanced CAUSAL ring attention over the zigzag layout.
+
+    Inputs must already be in zigzag order along the sequence dim (use
+    :func:`zigzag_permutation` once at the data layer — positions/RoPE and
+    targets must be permuted consistently); the output is returned in the
+    same layout.  Requires ``L % (2·axis_size) == 0``.  Always causal
+    (the zigzag layout exists to balance the causal mask's work) and always
+    flash-inner.  ``kmask`` follows the same layout.
+    """
+    L = q.shape[2]
+    size = mesh.shape[axis_name]
+    if L % (2 * size):
+        raise ValueError(
+            f"zigzag layout needs L divisible by 2*axis_size = {2 * size}, "
+            f"got {L}"
+        )
+    ba = _resolve_batch_axis(q, mesh, axis_name, batch_axis)
+    qkv_spec = P(ba, None, axis_name, None)
+    mask_spec = P(ba, axis_name)
+    body = functools.partial(_zigzag_shard, axis_name=axis_name, size=size)
+    return _seq_shard_map(body, mesh, qkv_spec, mask_spec, q, k, v, kmask)
 
 
 def _ulysses_shard(q, k, v, kmask, *, axis_name, causal, scale, inner):
@@ -353,19 +522,7 @@ def ulysses_attention(
         scale=1.0 / (q.shape[-1] ** 0.5),
         inner=inner,
     )
-    if kmask is None:
-        fn = shard_map(
-            lambda q, k, v: body(q, k, v, None),
-            mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec), out_specs=qkv_spec,
-        )
-        return fn(q, k, v)
-    fn = shard_map(
-        lambda q, k, v, km: body(q, k, v, km),
-        mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
-        out_specs=qkv_spec,
-    )
-    return fn(q, k, v, kmask)
+    return _seq_shard_map(body, mesh, qkv_spec, mask_spec, q, k, v, kmask)
 
 
 def _as_model_attention(impl, mesh, axis_name, batch_axis, causal, inner):

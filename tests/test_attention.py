@@ -357,3 +357,80 @@ def test_inner_auto_falls_back_to_dense_on_awkward_length(rng, devices):
     assert _resolve_inner("auto", 520) == "dense"
     with pytest.raises(ValueError, match="inner must be"):
         ulysses_attention(q, k, v, mesh=mesh, axis_name="seq", inner="bogus")
+
+
+# ----------------------- zigzag causal ring (balanced) --------------------- #
+
+
+def test_zigzag_ring_matches_dense_causal(rng, devices):
+    """Zigzag-layout causal ring (device d holds blocks d and 2n-1-d for
+    equal per-hop causal work) matches dense causal attention in values and
+    gradients, with and without padding masks."""
+    from stoke_tpu.ops import (
+        inverse_permutation,
+        zigzag_permutation,
+        zigzag_ring_attention,
+    )
+
+    L2 = 64  # needs L % (2*8) == 0
+    mesh = mesh_2d(1, 8)
+    r = np.random.default_rng(11)
+    mk = lambda: jnp.asarray(r.normal(size=(B, H, L2, D)).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+    m = np.ones((B, L2), np.int32)
+    m[0, 50:] = 0
+    km = jnp.asarray(m)
+    perm = zigzag_permutation(L2, 8)
+    inv = inverse_permutation(perm)
+    zz = lambda x, ax: jnp.take(x, perm, axis=ax)
+    unzz = lambda x, ax: jnp.take(x, inv, axis=ax)
+
+    from stoke_tpu.ops.flash_attention import dense_reference
+
+    for use_mask in (False, True):
+        kmz = zz(km, 1) if use_mask else None
+        out = unzz(
+            zigzag_ring_attention(
+                zz(q, 2), zz(k, 2), zz(v, 2), kmz, mesh=mesh, axis_name="seq"
+            ),
+            2,
+        )
+        ref = dense_reference(q, k, v, km if use_mask else None, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6
+        )
+
+    def loss_z(q, k, v):
+        o = zigzag_ring_attention(
+            zz(q, 2), zz(k, 2), zz(v, 2), zz(km, 1), mesh=mesh,
+            axis_name="seq",
+        )
+        return jnp.sum(unzz(o, 2) ** 2)
+
+    def loss_d(q, k, v):
+        return jnp.sum(dense_reference(q, k, v, km, causal=True) ** 2)
+
+    gz = jax.grad(loss_z, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gz, gd):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_zigzag_permutation_helpers(rng, devices):
+    from stoke_tpu.ops import inverse_permutation, zigzag_permutation, \
+        zigzag_ring_attention
+
+    perm = zigzag_permutation(32, 4)  # 8 blocks of 4
+    assert sorted(perm.tolist()) == list(range(32))
+    # device 0's shard = blocks 0 and 7, device 1's = 1 and 6, ...
+    assert perm[:8].tolist() == [0, 1, 2, 3, 28, 29, 30, 31]
+    inv = inverse_permutation(perm)
+    assert (perm[inv] == np.arange(32)).all()
+    with pytest.raises(ValueError, match="divisible"):
+        zigzag_permutation(30, 4)
+    mesh = mesh_2d(1, 8)
+    with pytest.raises(ValueError, match="divisible"):
+        q = jnp.zeros((1, 2, 24, 8))  # 24 % 16 != 0
+        zigzag_ring_attention(q, q, q, mesh=mesh, axis_name="seq")
