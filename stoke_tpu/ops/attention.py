@@ -42,6 +42,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -226,7 +227,6 @@ def _ring_shard_flash(q, k, v, kmask, *, axis_name, causal, size):
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
     perm = [(i, (i + 1) % size) for i in range(size)]
-    merge = _lse_merge
 
     # hop 0: diagonal block, static causal flag
     o_hop, lse_hop = flash_attention(
@@ -246,7 +246,7 @@ def _ring_shard_flash(q, k, v, kmask, *, axis_name, causal, size):
         o_hop, lse_hop = flash_attention(
             q, k, v, hop_mask, causal=False, return_lse=True
         )
-        o, lse = merge(o, lse, o_hop, lse_hop)
+        o, lse = _lse_merge(o, lse, o_hop, lse_hop)
         return o, lse, k, v, km
 
     if size > 1:
@@ -320,8 +320,6 @@ def zigzag_permutation(L: int, size: int):
             f"got {L}"
         )
     Lb = L // (2 * size)
-    import numpy as np
-
     blocks = []
     for d in range(size):
         blocks.append(np.arange(d * Lb, (d + 1) * Lb))
@@ -331,8 +329,6 @@ def zigzag_permutation(L: int, size: int):
 
 
 def inverse_permutation(perm):
-    import numpy as np
-
     inv = np.empty_like(perm)
     inv[perm] = np.arange(len(perm))
     return inv
