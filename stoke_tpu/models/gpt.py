@@ -63,7 +63,14 @@ class GPT(nn.Module):
     chunked_head: bool = False
 
     @nn.compact
-    def __call__(self, input_ids, train: bool = True):
+    def __call__(self, input_ids, train: bool = True, positions=None):
+        """``positions`` (optional [L] or [B, L] int) overrides the default
+        ``arange`` position ids — required when the sequence is laid out in
+        a non-natural order (the zigzag layout of
+        ``ops.zigzag_ring_attention``, packed sequences): the position
+        embedding must follow each token's ORIGINAL position.  The causal
+        attention mask is the attention_fn's job in that case
+        (``attention_is_causal=True``)."""
         size: BertSize = BERT_SIZES[self.size_name]
         B, L = input_ids.shape
         if L > self.max_len:
@@ -74,7 +81,23 @@ class GPT(nn.Module):
             )
         tok_emb = nn.Embed(self.vocab_size, size.hidden, name="tok_emb")
         h = tok_emb(input_ids)
-        pos = jnp.arange(L)[None, :]
+        if positions is None:
+            pos = jnp.arange(L)[None, :]
+        else:
+            # concrete position ids are validated eagerly — XLA's gather
+            # would silently CLAMP out-of-range ids onto the max_len-1
+            # embedding (same failure mode as the L > max_len guard above);
+            # traced positions cannot be checked without a device sync
+            if not isinstance(positions, jax.core.Tracer):
+                pmax = int(np.max(np.asarray(positions)))
+                if pmax >= self.max_len:
+                    raise ValueError(
+                        f"GPT: positions contain id {pmax} >= "
+                        f"max_len={self.max_len}"
+                    )
+            pos = jnp.asarray(positions)
+            if pos.ndim == 1:
+                pos = pos[None, :]
         h = h + nn.Embed(self.max_len, size.hidden, name="pos_emb")(pos)
         h = nn.Dropout(self.dropout_rate)(h, deterministic=not train)
         if self.attention_is_causal:

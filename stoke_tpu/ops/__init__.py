@@ -8,6 +8,7 @@ from stoke_tpu.ops.attention import (
     inverse_permutation,
     make_ring_attention,
     make_ulysses_attention,
+    make_zigzag_ring_attention,
     ring_attention,
     ulysses_attention,
     zigzag_permutation,
@@ -29,6 +30,7 @@ __all__ = [
     "chunked_softmax_cross_entropy",
     "chunked_causal_lm_loss",
     "zigzag_ring_attention",
+    "make_zigzag_ring_attention",
     "zigzag_permutation",
     "inverse_permutation",
 ]

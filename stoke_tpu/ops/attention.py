@@ -534,6 +534,16 @@ def _as_model_attention(impl, mesh, axis_name, batch_axis, causal, inner):
             )
         kmask = None
         if bias is not None:
+            if bias.shape[-2] > 1:
+                # a full [.., L, L] bias (an in-model causal mask) would be
+                # silently misread as a key mask of its first row — refuse
+                raise ValueError(
+                    "sequence-parallel attention received a full [.., L, L] "
+                    "attention bias (an in-model causal mask?); these "
+                    "adapters support only [B, 1, 1, L] key-padding biases "
+                    "— set attention_is_causal=True on the model and let "
+                    "the attention enforce causality"
+                )
             # recover the [B, L] key mask from the additive [B,1,1,L] bias
             kmask = (bias[:, 0, 0, :] > -1e8).astype(jnp.int32)
         return impl(
@@ -563,4 +573,29 @@ def make_ulysses_attention(
     ``BertEncoder(attention_fn=...)``."""
     return _as_model_attention(
         ulysses_attention, mesh, axis_name, batch_axis, causal, inner
+    )
+
+
+def make_zigzag_ring_attention(
+    mesh: Mesh, axis_name: str = "seq", batch_axis: str = "data",
+) -> Callable:
+    """Build a zigzag-ring ``attention_fn`` (always causal, flash-inner).
+
+    The MODEL must run on zigzag-ordered sequences: permute tokens/masks
+    with :func:`zigzag_permutation` at the data layer and pass the
+    permutation as the model's position ids (``GPT(..., positions=perm)``)
+    so position embeddings follow original positions.  Set
+    ``attention_is_causal=True`` — causality is enforced here, by original
+    positions."""
+
+    def impl(q, k, v, kmask, *, mesh, axis_name, causal, batch_axis, inner):
+        # zigzag is always causal and flash-inner; the extra kwargs exist
+        # only to fit the shared adapter signature
+        return zigzag_ring_attention(
+            q, k, v, kmask, mesh=mesh, axis_name=axis_name,
+            batch_axis=batch_axis,
+        )
+
+    return _as_model_attention(
+        impl, mesh, axis_name, batch_axis, causal=True, inner="flash"
     )
