@@ -53,7 +53,9 @@ def _load_results() -> dict:
         return {}
 
 
-def _persist_result(metric: str, record: dict) -> None:
+def persist_result(metric: str, record: dict) -> None:
+    """Record a verified measurement in the BENCH_RESULTS.json ledger
+    (public: scripts/accuracy_run.py persists its gate numbers here too)."""
     results = _load_results()
     results[metric] = record
     tmp = RESULTS_PATH + ".tmp"
@@ -61,6 +63,9 @@ def _persist_result(metric: str, record: dict) -> None:
         json.dump(results, f, indent=2)
         f.write("\n")
     os.replace(tmp, RESULTS_PATH)
+
+
+_persist_result = persist_result  # internal alias
 
 
 def _emit_persisted(metric: str, capture_error: str,
