@@ -11,10 +11,18 @@ to ``BENCH_RESULTS.json`` (value + date + methodology).  The TPU in this
 environment is reached through a single-client remote tunnel that wedges for
 long stretches; when a fresh measurement is impossible at capture time, the
 emitted ``value`` is the persisted last verified on-chip number — flagged
-with ``"fresh": false``, the measurement date, and the capture error — so
-the official record reflects what the framework measurably does on the chip
-rather than the tunnel's state at capture time.  A 0.0 is emitted only if
-there has never been a successful on-chip measurement.
+with ``"fresh": false``, ``"stale": true``, the measurement date, and the
+capture error — so the official record reflects what the framework
+measurably does on the chip rather than the tunnel's state at capture time.
+A 0.0 is emitted only if there has never been a successful on-chip
+measurement.
+
+Contract note (ADVICE r3): any consumer treating ``value`` as *this run's*
+measurement must gate on ``fresh: true``; a ``fresh: false`` line is a
+re-citation of the ledger, never a new data point.  Substitution is further
+restricted to ledger records whose ``backend`` field (or legacy ``source``
+text) proves an accelerator capture — a CPU-backed record is never emitted
+as the on-chip headline.
 
 Baseline: the reference publishes no numbers (BASELINE.md); the north star is
 "CIFAR-10 ResNet-50 per-chip throughput matching an A100 running the
@@ -68,6 +76,20 @@ def persist_result(metric: str, record: dict) -> None:
 _persist_result = persist_result  # internal alias
 
 
+def record_backend(rec: dict) -> str:
+    """Best-effort backend of a ledger record: the structured ``backend``
+    field when present, else inferred from legacy free-text fields (records
+    written before ADVICE r3 added the field)."""
+    if rec.get("backend"):
+        return rec["backend"]
+    text = " ".join(
+        str(rec.get(k, "")) for k in ("source", "note")
+    ).lower()
+    if "cpu" in text and "tpu" not in text:
+        return "cpu"
+    return "tpu" if "tpu" in text or "chip" in text else "unknown"
+
+
 def _emit_persisted(metric: str, capture_error: str,
                     requested: dict | None = None) -> int:
     """Emit the last verified on-chip measurement as the official value.
@@ -79,6 +101,13 @@ def _emit_persisted(metric: str, capture_error: str,
     DIFFERENT configuration is never substituted for it.
     """
     rec = _load_results().get(metric)
+    if rec and record_backend(rec) in ("cpu", "unknown"):
+        capture_error += (
+            f" [persisted record not applicable: backend is "
+            f"{record_backend(rec)!r}, not a proven accelerator capture — "
+            f"never substituted as the on-chip headline]"
+        )
+        rec = None
     if rec and requested:
         for key, want in requested.items():
             if want is not None and rec.get(key) != want:
@@ -95,6 +124,8 @@ def _emit_persisted(metric: str, capture_error: str,
             "unit": rec.get("unit", "imgs/sec/chip"),
             "vs_baseline": round(rec["value"] / A100_BASELINE_IMGS_PER_SEC, 4),
             "fresh": False,
+            "stale": True,
+            "backend": record_backend(rec),
             "measured_on": rec.get("date"),
             "measured_by": rec.get("source", "bench.py"),
             "api": rec.get("api"),
@@ -359,6 +390,7 @@ def main():
                 "batch": batch,
                 "steps_per_dispatch": per_call,
                 "source": "bench.py fresh capture",
+                "backend": jax.default_backend(),
             },
         )
 

@@ -189,9 +189,19 @@ if __name__ == "__main__":
         import bench as _bench
 
         metric = f"digits_{args.model}_top1"
-        prev = _bench._load_results().get(metric, {}).get("value", 0.0)
-        if acc >= 0.95 and acc > prev:
-            backend = _jax.default_backend()
+        backend = _jax.default_backend()
+        prev_rec = _bench._load_results().get(metric, {})
+        prev = prev_rec.get("value", 0.0)
+        # backend-aware keep-best (ADVICE r3): records carry a structured
+        # `backend` field; an accelerator measurement always outranks a CPU
+        # rehearsal regardless of value, so a high CPU number can never mask
+        # or block the on-chip gate result consumers actually want
+        rank = (0 if backend == "cpu" else 1, float(acc))
+        prev_rank = (
+            0 if _bench.record_backend(prev_rec) == "cpu" else 1,
+            float(prev),
+        ) if prev_rec else (-1, 0.0)
+        if acc >= 0.95 and rank > prev_rank:
             _bench.persist_result(
                 metric,
                 {
@@ -202,6 +212,7 @@ if __name__ == "__main__":
                     "api": f"{args.model}/{args.epochs}ep"
                     + ("/augment" if args.augment else ""),
                     "batch": 128,
+                    "backend": backend,
                     "source": f"scripts/accuracy_run.py on {backend}",
                     "note": "cpu f32 rehearsal (same facade/engine path; "
                     "on-chip bf16 re-run pending)"

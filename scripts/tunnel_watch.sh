@@ -1,0 +1,37 @@
+#!/bin/bash
+# Tunnel watcher (round 4): probe the single-client TPU relay every ~10 min
+# with a 120s timeout; the moment a probe succeeds, run the full measurement
+# session (scripts/tpu_session.py) which holds /tmp/tpu_in_use for its
+# duration.  One probe process at a time; never probe while a session runs.
+#
+#   nohup bash scripts/tunnel_watch.sh > /tmp/tunnel_watch.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+# single-instance guard: a second concurrent watcher probing the
+# single-client relay is itself a wedge trigger
+exec 9>/tmp/tunnel_watch.lock
+flock -n 9 || { echo "another tunnel_watch is already running; exiting"; exit 0; }
+LOG=/tmp/tpu_session_r04.log
+while true; do
+  if [ -f /tmp/tpu_in_use ]; then
+    echo "$(date -u +%H:%M:%S) session holds tunnel; sleeping"
+    sleep 600
+    continue
+  fi
+  echo "$(date -u +%H:%M:%S) probing tunnel..."
+  if timeout 125 python -c "import jax; assert jax.devices()[0].platform != 'cpu', jax.devices(); print('ALIVE', jax.devices())"; then
+    echo "$(date -u +%H:%M:%S) tunnel ALIVE -> launching tpu_session"
+    python scripts/tpu_session.py >> "$LOG" 2>&1
+    rc=$?
+    echo "$(date -u +%H:%M:%S) tpu_session exited rc=$rc (log: $LOG)"
+    if [ $rc -eq 0 ]; then
+      echo "SESSION_COMPLETE"
+      exit 0
+    fi
+    # session failed (likely mid-run wedge): back off longer, then resume probing
+    sleep 1200
+  else
+    echo "$(date -u +%H:%M:%S) probe failed/timed out; retry in 10 min"
+    sleep 600
+  fi
+done

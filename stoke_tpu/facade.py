@@ -1530,17 +1530,25 @@ class Stoke:
                 loaded_vars = {
                     **loaded_vars, "losses": self._variables["losses"]
                 }
-        except ValueError as first_err:
+        except Exception as first_err:
             # legacy layout: a checkpoint saved before sown losses were
             # excluded mismatches the stripped template (consolidated:
-            # leaf-count error; sharded: orbax structure error).  Retry
-            # with the full template — but if that fails too, surface the
-            # ORIGINAL error (a genuine incompatibility), not the retry's
+            # leaf-count ValueError; sharded: orbax structure errors, which
+            # surface as KeyError/TypeError or orbax-specific types — so the
+            # retry decision cannot key on the exception class).  Retry with
+            # the full template — but if that fails too, surface the
+            # ORIGINAL error (a genuine incompatibility), not the retry's.
+            # Errors that cannot possibly be a template mismatch skip the
+            # retry — a second full restore of a multi-GB sharded checkpoint
+            # is expensive and would surface the same error anyway
+            if isinstance(first_err, (FileNotFoundError, NotADirectoryError,
+                                      PermissionError, IsADirectoryError)):
+                raise
             if "losses" not in self._variables:
                 raise
             try:
                 payload = _load(self._variables)
-            except ValueError:
+            except Exception:
                 raise first_err
             loaded_vars = payload["variables"]
         self._variables = loaded_vars

@@ -278,16 +278,34 @@ class StokeStatus:
             if cfg is None:
                 return False
             import os
+            import uuid
 
+            # NOTE: validation intentionally creates the log directory (so
+            # the first mid-training log call can't fail on a missing path)
+            # and probes actual writability with a throwaway file — makedirs
+            # succeeding does not prove event files can be written
+            # (permissions/quota can still fail at first write)
+            target = os.path.join(cfg.output_path, cfg.job_name)
             try:
-                os.makedirs(
-                    os.path.join(cfg.output_path, cfg.job_name), exist_ok=True
+                os.makedirs(target, exist_ok=True)
+                probe = os.path.join(
+                    target, f".stoke-write-probe-{uuid.uuid4().hex[:8]}"
                 )
+                with open(probe, "wb") as f:
+                    f.write(b"ok")
+                os.remove(probe)
                 return False
             except OSError as e:
+                # only process 0 ever writes event files (facade._tb_writer
+                # gates on is_rank_0) — a worker on a read-only mount of a
+                # coordinator-owned log dir must not kill the whole job
+                import jax
+
+                if jax.process_index() != 0:
+                    return False
                 return (
                     f"TensorboardConfig output path "
-                    f"{cfg.output_path!r}/{cfg.job_name!r} is not creatable: {e}"
+                    f"{cfg.output_path!r}/{cfg.job_name!r} is not writable: {e}"
                 )
 
         def _offload_cpu_no_fallback(s):

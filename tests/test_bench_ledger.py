@@ -1,0 +1,85 @@
+"""Unit tests for bench.py's measurement-ledger logic (ADVICE r3).
+
+The driver parses exactly one JSON line from ``python bench.py``; when a
+fresh on-chip capture is impossible the emitted value is the persisted last
+verified measurement.  These tests pin the substitution rules: never a
+CPU-backed record, never a record measured under a different requested
+configuration, and always flagged ``fresh: false, stale: true``.
+"""
+
+import json
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+import bench
+
+
+@pytest.fixture
+def ledger(tmp_path, monkeypatch):
+    path = tmp_path / "BENCH_RESULTS.json"
+    monkeypatch.setattr(bench, "RESULTS_PATH", str(path))
+    return path
+
+
+def _emit(capsys, metric, err="probe timed out", requested=None):
+    rc = bench._emit_persisted(metric, err, requested)
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    return rc, json.loads(out)
+
+
+def test_record_backend_structured_and_legacy():
+    assert bench.record_backend({"backend": "tpu"}) == "tpu"
+    assert bench.record_backend({"backend": "cpu"}) == "cpu"
+    # legacy records (pre-ADVICE-r3) are inferred from free text
+    assert bench.record_backend(
+        {"source": "bench_sweep.py on real TPU v5e"}) == "tpu"
+    assert bench.record_backend(
+        {"source": "scripts/accuracy_run.py on cpu"}) == "cpu"
+    assert bench.record_backend({}) == "unknown"
+
+
+def test_emit_persisted_substitutes_accelerator_record(ledger, capsys):
+    bench.persist_result("m", {"value": 9000.0, "unit": "imgs/sec/chip",
+                               "date": "2026-07-29", "api": "train_steps",
+                               "batch": 256, "backend": "tpu"})
+    rc, out = _emit(capsys, "m")
+    assert rc == 0
+    assert out["value"] == 9000.0
+    assert out["fresh"] is False and out["stale"] is True
+    assert out["backend"] == "tpu"
+    assert "capture_error" in out
+
+
+def test_emit_persisted_refuses_cpu_record(ledger, capsys):
+    bench.persist_result("m", {"value": 9999.0, "backend": "cpu",
+                               "date": "2026-07-29"})
+    rc, out = _emit(capsys, "m")
+    assert rc == 1
+    assert out["value"] == 0.0
+    assert "not a proven accelerator capture" in out.get("error", "")
+
+
+def test_emit_persisted_refuses_unknown_backend(ledger, capsys):
+    # a record whose backend cannot be proven (hand-edited, no backend
+    # field, uninformative source text) is never the on-chip headline
+    bench.persist_result("m", {"value": 9999.0,
+                               "source": "manual rerun, see notes"})
+    rc, out = _emit(capsys, "m")
+    assert rc == 1
+    assert out["value"] == 0.0
+
+
+def test_emit_persisted_refuses_config_mismatch(ledger, capsys):
+    bench.persist_result("m", {"value": 9000.0, "backend": "tpu",
+                               "api": "train_steps", "batch": 256})
+    rc, out = _emit(capsys, "m", requested={"api": "4call", "batch": None})
+    assert rc == 1
+    assert out["value"] == 0.0
+    assert "not applicable" in out.get("error", "")
+
+
+def test_emit_persisted_no_record(ledger, capsys):
+    rc, out = _emit(capsys, "never_measured")
+    assert rc == 1 and out["value"] == 0.0

@@ -246,12 +246,21 @@ def test_tensorboard_config_validates_output_path(tmp_path):
     ok = TensorboardConfig(output_path=str(tmp_path / "tb"))
     StokeStatus(batch_size_per_device=8, configs=[ok])
     assert (tmp_path / "tb").exists()
+    # the probe file is cleaned up (ADVICE r3: writability is proven by a
+    # real write, not just makedirs)
+    assert not any(
+        p.name.startswith(".stoke-write-probe")
+        for p in (tmp_path / "tb" / "stoke").iterdir()
+    )
     # an impossible path (a FILE in the way) fails at init
     blocker = tmp_path / "blocked"
     blocker.write_text("not a directory")
     bad = TensorboardConfig(output_path=str(blocker))
-    with pytest.raises(StokeValidationError, match="not creatable"):
+    with pytest.raises(StokeValidationError, match="not writable"):
         StokeStatus(batch_size_per_device=8, configs=[bad])
+    # (a permission-denied directory would also fail at the write probe,
+    # but root — as in this CI image — bypasses mode bits, so that arm
+    # is not simulatable here)
 
 
 def test_reference_aliases():
