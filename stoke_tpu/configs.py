@@ -150,6 +150,15 @@ class PrecisionConfig:
         backoff_factor: scale multiplier on overflow (reference 0.5).
         growth_interval: finite-step window before growth (reference 2000).
         min_scale: floor for the dynamic scale.
+        num_losses: number of independent loss scalers (reference Apex
+            ``num_losses`` / per-loss ``amp.scale_loss(..., loss_id)``,
+            fp16.py:545-579, :656-691).  With ``num_losses > 1`` each leaf of
+            the user's ``loss()`` return gets its own dynamic scale: the
+            shared forward is differentiated once per loss (VJP seeded with
+            that loss's scale — same backward count as the reference's
+            ``retain_graph`` loop), gradients are unscaled into the
+            accumulation buffer immediately, and per-loss overflow backs off
+            only the offending loss's scale.  fp16 only.
     """
 
     param_dtype: str = "float32"
@@ -159,6 +168,7 @@ class PrecisionConfig:
     backoff_factor: float = 0.5
     growth_interval: int = 2000
     min_scale: float = 1.0
+    num_losses: int = 1
 
 
 # --------------------------------------------------------------------------- #
@@ -480,6 +490,14 @@ class CheckpointConfig:
     failure-recovery story (checkpoint-restart) — the reference has no
     failure handling at all (SURVEY.md §5: "static world; crash = job
     death").
+
+    ``save_rank`` picks which process writes the consolidated payload and
+    the metadata (reference ``DDPIO._save_rank`` / OSS
+    ``consolidate_state_dict(recipient_rank)``, io_ops.py:551-623) — useful
+    when only one host mounts durable storage.  Taken modulo the process
+    count, so a config written for a larger pod degrades safely.  Sharded
+    saves always write from every process; ``save_rank`` then only selects
+    the metadata writer.
     """
 
     format: CheckpointFormat = CheckpointFormat.consolidated
@@ -488,6 +506,7 @@ class CheckpointConfig:
     save_every_n_steps: Optional[int] = None
     auto_path: Optional[str] = None
     auto_name: str = "auto"
+    save_rank: int = 0
 
 
 # --------------------------------------------------------------------------- #

@@ -265,7 +265,20 @@ class PrecisionPolicy(NamedTuple):
 def init_scaler_state(cfg: PrecisionConfig) -> Dict[str, Any]:
     """Dynamic loss-scaler state (functional GradScaler, reference
     fp16.py:731-748).  Created as host numpy so construction never touches
-    the default accelerator backend (the facade places it explicitly)."""
+    the default accelerator backend (the facade places it explicitly).
+
+    With ``num_losses > 1`` (reference Apex per-loss scalers,
+    fp16.py:656-691) every field becomes a ``[num_losses]`` vector and a
+    per-loss ``finite`` flag vector is carried: the accumulate step ANDs in
+    each loss's backward finiteness, the apply step feeds the flags to the
+    vectorized scaler update and resets them."""
+    if cfg.num_losses > 1:
+        n = cfg.num_losses
+        return {
+            "scale": np.full(n, cfg.init_scale, np.float32),
+            "growth_count": np.zeros(n, np.int32),
+            "finite": np.ones(n, np.bool_),
+        }
     return {
         "scale": np.float32(cfg.init_scale),
         "growth_count": np.int32(0),
@@ -274,7 +287,9 @@ def init_scaler_state(cfg: PrecisionConfig) -> Dict[str, Any]:
 
 def _scaler_update(state, finite, cfg: PrecisionConfig):
     """GradScaler.update() semantics (reference fp16.py:805-806): grow scale
-    after ``growth_interval`` consecutive finite steps, back off on overflow."""
+    after ``growth_interval`` consecutive finite steps, back off on overflow.
+    Elementwise, so a ``[num_losses]`` scale vector with a per-loss finite
+    vector updates each loss's scaler independently."""
     grew = state["growth_count"] + 1 >= cfg.growth_interval
     new_scale = jnp.where(
         finite,
@@ -462,6 +477,15 @@ class StepEngine:
             k: v for k, v in self._var_device_shardings.items() if k != "params"
         }
 
+    def _scaler_shardings(self):
+        """Replicated placement for every scaler-state leaf.  The structure
+        varies with the mode: per-loss scaling (``num_losses > 1``) carries
+        an extra ``finite`` flag vector alongside scale/growth_count."""
+        base = {"scale": self._repl, "growth_count": self._repl}
+        if self.precision.scaled and self.precision_config.num_losses > 1:
+            base["finite"] = self._repl
+        return base
+
     def _offload_shardings(self, shardings, cfg, what: str):
         """Re-target a sharding tree to host memory
         (``memory_kind="pinned_host"``) — the ZeRO-offload equivalent
@@ -616,8 +640,9 @@ class StepEngine:
         ``deferred_info`` records (flat_index, extraction_path) for each
         removed leaf so the real forward output is substituted inside the
         trace.  Returns (loss_tree, updated_nonparam_vars, new_grad_buf,
-        new_rng) — all device-resident; nothing syncs to host
-        (SURVEY.md §3.2 observation (a)).
+        new_scaler_state, new_rng) — all device-resident; nothing syncs to
+        host (SURVEY.md §3.2 observation (a)).  The scaler is pass-through
+        except in per-loss mode (``PrecisionConfig.num_losses > 1``).
         """
         struct_key = (
             "accum",
@@ -636,9 +661,17 @@ class StepEngine:
 
     def _accum_core(self, loss_treedef, deferred_info, training):
         """Unjitted micro-step core: forward + loss + grad + buffer add.
-        Shared by the lazy 4-call path and the fused train_step path."""
+        Shared by the lazy 4-call path and the fused train_step path.
+
+        Returns ``(report, updated_nonparam, new_buf, new_scaler, new_rng)``.
+        The scaler is pass-through except in per-loss mode (``num_losses >
+        1``), where each micro-step ANDs per-loss backward finiteness into
+        the carried flag vector (reference: Apex updates its per-loss
+        scalers inside each ``scale_loss`` context, fp16.py:545-579)."""
         inv_scale_accum = 1.0 / self.grad_accum if training else 1.0
         scaled = self.precision.scaled
+        per_loss = scaled and self.precision_config.num_losses > 1
+        n_scales = self.precision_config.num_losses
 
         def _loss_from_out(out, loss_args_flat):
             flat = list(loss_args_flat)
@@ -655,9 +688,25 @@ class StepEngine:
             # the gradients host→device for the buffer add)
             variables = self._vars_to_compute(variables)
             new_rng, sub = jax.random.split(rng)
-            scale = scaler_state["scale"] if scaled else jnp.float32(1.0)
+            # per-loss mode scales the VJP seeds instead of the objective
+            scale = (
+                scaler_state["scale"]
+                if scaled and not per_loss
+                else jnp.float32(1.0)
+            )
 
-            def lf(params):
+            def _forward_comps(params):
+                """Shared forward + per-leaf weighted loss components.
+
+                Returns ``(comps, report, updated)``: ``comps`` is one f32
+                scalar per loss leaf (weights applied, model-internal aux
+                losses folded into the FIRST component — they have no
+                scaler/weight slot of their own), ``report`` the UNweighted
+                per-loss values the user sees.  ``lf`` consumes the sum
+                (single backward), ``lf_vec`` the stacked vector (one
+                scale-seeded backward per loss) — sharing this body is what
+                keeps the two objectives from drifting.
+                """
                 vars_in = {**variables, "params": params}
                 fwd = self._maybe_remat(
                     lambda v: self._run_forward_train(v, sub, margs, mkwargs)
@@ -669,8 +718,7 @@ class StepEngine:
                     # weighted multi-loss: the objective is Σ wᵢ·lossᵢ.
                     # Gradients are linear, so one backward of the weighted
                     # sum ≡ the reference's per-loss backward passes with
-                    # weights (fp16.py:545-579, stoke.py:891-902); per-loss
-                    # overflow isolation is subsumed by the single scaler.
+                    # weights (fp16.py:545-579, stoke.py:891-902).
                     try:
                         weighted = jax.tree_util.tree_map(
                             lambda w, l: jnp.float32(w)
@@ -683,11 +731,11 @@ class StepEngine:
                             "Stoke -- loss_weights structure must match the "
                             "loss() return structure"
                         ) from e
-                    total = sum(jax.tree_util.tree_leaves(weighted))
+                    comps = jax.tree_util.tree_leaves(weighted)
                 else:
-                    total = sum(
+                    comps = [
                         jnp.asarray(l, jnp.float32).sum() for l in leaves
-                    )
+                    ]
                 # model-internal auxiliary losses (e.g. the MoE router's
                 # load-balancing term) arrive sown into the "losses"
                 # collection (models/moe.py); they join the objective with
@@ -696,30 +744,86 @@ class StepEngine:
                 if self.aux_loss_weight and "losses" in updated:
                     aux_leaves = jax.tree_util.tree_leaves(updated["losses"])
                     if aux_leaves:
-                        total = total + jnp.float32(self.aux_loss_weight) * sum(
+                        comps[0] = comps[0] + jnp.float32(
+                            self.aux_loss_weight
+                        ) * sum(
                             jnp.asarray(a, jnp.float32).sum()
                             for a in aux_leaves
                         )
-                # reference divides the training loss by grad_accum at loss()
-                # time (stoke.py:901-911); fp16 additionally scales for the
-                # dynamic scaler.  Reported per-loss values stay UNweighted.
-                objective = total * inv_scale_accum * scale
+                # reference divides the training loss by grad_accum at
+                # loss() time (stoke.py:901-911).  Reported per-loss values
+                # stay UNweighted.
                 report = jax.tree_util.tree_unflatten(
                     inner_def, [l * inv_scale_accum for l in leaves]
                 )
+                return comps, report, updated
+
+            def lf(params):
+                comps, report, updated = _forward_comps(params)
+                # fp16 single-scaler mode additionally multiplies by the
+                # dynamic scale; per-loss overflow isolation is subsumed by
+                # the single scaler here.
+                objective = sum(comps) * inv_scale_accum * scale
                 return objective, (report, updated)
 
-            if training:
+            def lf_vec(params):
+                # per-loss objective VECTOR: components stay separate so
+                # each loss's backward can be seeded with its own scale
+                comps, report, updated = _forward_comps(params)
+                if len(comps) != n_scales:
+                    raise ValueError(
+                        f"Stoke -- PrecisionConfig.num_losses={n_scales} "
+                        f"but loss() returned {len(comps)} loss leaves — "
+                        f"per-loss scalers need one scale per loss"
+                    )
+                return (
+                    jnp.stack(comps) * inv_scale_accum,
+                    (report, updated),
+                )
+
+            if training and per_loss:
+                # reference per-loss scalers (fp16.py:545-579): one forward,
+                # one backward per loss.  jax.vjp shares the forward; each
+                # backward is seeded with that loss's scale (protecting fp16
+                # cotangents from underflow), checked for overflow, then
+                # unscaled straight into the fp32 accumulation buffer —
+                # which therefore holds UNSCALED gradients (apply's unscale
+                # is the identity in this mode).
+                scales = scaler_state["scale"]
+                _, vjp_fn, (report, updated) = jax.vjp(
+                    lf_vec, variables["params"], has_aux=True
+                )
+                new_buf = grad_buf
+                new_finite = scaler_state["finite"]
+                for i in range(n_scales):
+                    seed = (
+                        jnp.zeros((n_scales,), jnp.float32)
+                        .at[i].set(scales[i])
+                    )
+                    (g_i,) = vjp_fn(seed)
+                    new_finite = new_finite.at[i].set(
+                        new_finite[i] & tree_finite(g_i)
+                    )
+                    inv_i = 1.0 / scales[i]
+                    new_buf = jax.tree_util.tree_map(
+                        lambda b, g: b + (g * inv_i).astype(b.dtype),
+                        new_buf,
+                        g_i,
+                    )
+                new_scaler = {**scaler_state, "finite": new_finite}
+            elif training:
                 grads, (report, updated) = jax.grad(lf, has_aux=True)(
                     variables["params"]
                 )
                 new_buf = jax.tree_util.tree_map(
                     lambda b, g: b + g.astype(b.dtype), grad_buf, grads
                 )
+                new_scaler = scaler_state
             else:
                 _, (report, updated) = lf(variables["params"])
                 new_buf = grad_buf
-            return report, updated, new_buf, new_rng
+                new_scaler = scaler_state
+            return report, updated, new_buf, new_scaler, new_rng
 
         return _step
 
@@ -739,6 +843,7 @@ class StepEngine:
                 # at the apply boundary where the tier placement is required
                 self._nonparam_device_shardings(),
                 self._grad_shardings,
+                self._scaler_shardings(),
                 repl,  # rng
             )
             return jax.jit(_step, out_shardings=out_sh)
@@ -799,20 +904,22 @@ class StepEngine:
             nonparam0 = {k: v for k, v in variables.items() if k != "params"}
 
             def body(carry, xs):
-                nonparam, buf, rng = carry
+                nonparam, buf, scaler, rng = carry
                 margs, mkwargs, larr = xs
-                report, updated, buf, rng = accum(
-                    {"params": params, **nonparam}, buf, scaler_state, rng,
+                report, updated, buf, scaler, rng = accum(
+                    {"params": params, **nonparam}, buf, scaler, rng,
                     margs, mkwargs, larr,
                 )
-                return ({**nonparam, **updated}, buf, rng), report
+                return ({**nonparam, **updated}, buf, scaler, rng), report
 
-            (nonparam_f, new_buf, new_rng), reports = jax.lax.scan(
-                body, (nonparam0, grad_buf, rng), (margs_s, mkwargs_s, larr_s)
+            (nonparam_f, new_buf, scaler_mid, new_rng), reports = jax.lax.scan(
+                body,
+                (nonparam0, grad_buf, scaler_state, rng),
+                (margs_s, mkwargs_s, larr_s),
             )
             merged = {"params": params, **nonparam_f}
             new_vars, new_opt, zero_buf, new_scaler, finite = apply_core(
-                merged, opt_state, new_buf, scaler_state
+                merged, opt_state, new_buf, scaler_mid
             )
             return (reports, new_vars, new_opt, zero_buf, new_scaler,
                     new_rng, finite)
@@ -829,7 +936,7 @@ class StepEngine:
                 self._var_shardings,
                 self._opt_shardings,
                 self._grad_shardings,
-                {"scale": repl, "growth_count": repl},
+                self._scaler_shardings(),
                 repl,
                 repl,
             )
@@ -918,7 +1025,7 @@ class StepEngine:
                 self._var_shardings,
                 self._opt_shardings,
                 self._grad_shardings,
-                {"scale": repl, "growth_count": repl},
+                self._scaler_shardings(),
                 repl,  # rng
                 repl,  # skipped count
             )
@@ -948,9 +1055,21 @@ class StepEngine:
             variables = self._vars_to_compute(variables)
             opt_state = self._opt_to_compute(opt_state)
             params = variables["params"]
-            inv = 1.0 / scaler_state["scale"] if scaled else jnp.float32(1.0)
+            per_loss = scaled and cfg.num_losses > 1
+            if per_loss:
+                # per-loss mode unscales inside the accumulate step (each
+                # backward by its own scale); the buffer is already unscaled
+                inv = jnp.float32(1.0)
+            else:
+                inv = (
+                    1.0 / scaler_state["scale"] if scaled else jnp.float32(1.0)
+                )
             grads = jax.tree_util.tree_map(lambda g: g * inv, grad_buf)
             finite = tree_finite(grads) if scaled else jnp.asarray(True)
+            if per_loss:
+                # any loss overflowing anywhere in the window skips the step
+                # (reference: amp skips optimizer.step on overflow)
+                finite = finite & jnp.all(scaler_state["finite"])
             grads = clip_gradients(grads, grad_clip)
 
             def do_update(_):
@@ -962,9 +1081,25 @@ class StepEngine:
                 return params, opt_state
 
             new_params, new_opt = jax.lax.cond(finite, do_update, skip_update, None)
-            new_scaler = (
-                _scaler_update(scaler_state, finite, cfg) if scaled else scaler_state
-            )
+            if per_loss:
+                # vectorized update driven by the per-loss flags, which then
+                # reset for the next accumulation window
+                upd = _scaler_update(
+                    {
+                        "scale": scaler_state["scale"],
+                        "growth_count": scaler_state["growth_count"],
+                    },
+                    scaler_state["finite"],
+                    cfg,
+                )
+                new_scaler = {
+                    **upd,
+                    "finite": jnp.ones_like(scaler_state["finite"]),
+                }
+            elif scaled:
+                new_scaler = _scaler_update(scaler_state, finite, cfg)
+            else:
+                new_scaler = scaler_state
             new_vars = {**variables, "params": new_params}
             zero_buf = tree_zeros_like(grad_buf)
             return new_vars, new_opt, zero_buf, new_scaler, finite
@@ -978,7 +1113,7 @@ class StepEngine:
                 self._var_shardings,
                 self._opt_shardings,
                 self._grad_shardings,
-                {"scale": self._repl, "growth_count": self._repl},
+                self._scaler_shardings(),
                 self._repl,
             )
             return jax.jit(_apply, out_shardings=out_sh, donate_argnums=(0, 1, 2))
@@ -1051,13 +1186,13 @@ class StepEngine:
                 # (the cores' own transfers become no-ops on already-device
                 # params)
                 variables = self._vars_to_compute(variables)
-                report, updated, new_buf, new_rng = accum(
+                report, updated, new_buf, scaler_mid, new_rng = accum(
                     variables, grad_buf, scaler_state, rng, margs, mkwargs,
                     larr
                 )
                 merged = {**variables, **updated}
                 new_vars, new_opt, zero_buf, new_scaler, finite = apply_core(
-                    merged, opt_state, new_buf, scaler_state
+                    merged, opt_state, new_buf, scaler_mid
                 )
                 return (report, updated, new_vars, new_opt, zero_buf,
                         new_scaler, new_rng, finite)
@@ -1070,7 +1205,7 @@ class StepEngine:
                     self._var_shardings,
                     self._opt_shardings,
                     self._grad_shardings,
-                    {"scale": repl, "growth_count": repl},
+                    self._scaler_shardings(),
                     repl,  # rng
                     repl,  # finite
                 )
@@ -1082,11 +1217,11 @@ class StepEngine:
         def _fused_nb(variables, grad_buf, scaler_state, rng, margs, mkwargs,
                       larr):
             variables = self._vars_to_compute(variables)
-            report, updated, new_buf, new_rng = accum(
+            report, updated, new_buf, new_scaler, new_rng = accum(
                 variables, grad_buf, scaler_state, rng, margs, mkwargs, larr
             )
             merged = {**variables, **updated}
-            return (report, updated, merged, new_buf, scaler_state, new_rng,
+            return (report, updated, merged, new_buf, new_scaler, new_rng,
                     jnp.asarray(True))
 
         if self.rules is not None:
@@ -1100,7 +1235,7 @@ class StepEngine:
                 # trip; only the boundary step persists to the offload tier
                 self._var_device_shardings,
                 self._grad_shardings,
-                {"scale": repl, "growth_count": repl},
+                self._scaler_shardings(),
                 repl,  # rng
                 repl,  # finite
             )

@@ -350,7 +350,7 @@ class Stoke:
         self._training = True
         self._token = 0
         self._stashed_model_call: Optional[tuple] = None
-        self._pending: Optional[tuple] = None  # (new_grad_buf, token)
+        self._pending: Optional[tuple] = None  # (new_grad_buf, new_scaler, token)
 
         self._replication_warned: set = set()
         self._materialize_warned = False
@@ -602,22 +602,27 @@ class Stoke:
             # .value materialization uses, so dropout masks always agree
             margs, mkwargs, token, rng = self._stashed_model_call
             arrays = self._place_batch(arrays)
-            report, updated, new_buf, new_rng = self._engine.accum_step(
-                self._variables,
-                self._grad_buf,
-                self._scaler_state,
-                rng,
-                margs,
-                mkwargs,
-                arrays,
-                treedef,
-                tuple(deferred_info),
-                True,
+            report, updated, new_buf, new_scaler, new_rng = (
+                self._engine.accum_step(
+                    self._variables,
+                    self._grad_buf,
+                    self._scaler_state,
+                    rng,
+                    margs,
+                    mkwargs,
+                    arrays,
+                    treedef,
+                    tuple(deferred_info),
+                    True,
+                )
             )
             self._rng = new_rng
             if updated:
                 self._variables = {**self._variables, **updated}
-            self._pending = (new_buf, token)
+            # new_scaler (carrying per-loss overflow flags in num_losses>1
+            # mode) commits at backward() time together with the buffer —
+            # a dropped pending loss must not skip steps or back off scales
+            self._pending = (new_buf, new_scaler, token)
             self._update_loss_tracking(report)
             return report
         # eval path (or no deferred handle): materialize + loss-only
@@ -650,8 +655,11 @@ class Stoke:
                 "Stoke -- backward() called without a preceding loss() on a "
                 "model() output"
             )
-        new_buf, _ = self._pending
+        new_buf, new_scaler, _ = self._pending
         self._grad_buf = new_buf
+        # per-loss fp16 mode: overflow flags observed in the micro-step
+        # join the scaler state only now that its grads are committed
+        self._scaler_state = new_scaler
         self._pending = None
         self._grad_accum_counter += 1
         self._backward_steps += 1
@@ -829,7 +837,12 @@ class Stoke:
         if self._last_step_loss is not None:
             w.add_scalar("loss/micro", self.step_loss, step)
         if self._precision.scaled:
-            w.add_scalar("scaler/loss_scale", self.loss_scale, step)
+            ls = self.loss_scale
+            if isinstance(ls, list):  # per-loss scalers: one curve each
+                for i, v in enumerate(ls):
+                    w.add_scalar(f"scaler/loss_scale_{i}", v, step)
+            else:
+                w.add_scalar("scaler/loss_scale", ls, step)
             w.add_scalar("scaler/skipped_steps", self.skipped_optimizer_steps, step)
         w.add_scalar("counters/backward_steps", self._backward_steps, step)
         w.flush()
@@ -1622,8 +1635,13 @@ class Stoke:
         return self._scaler_state
 
     @property
-    def loss_scale(self) -> float:
-        return float(jax.device_get(self._scaler_state["scale"]))
+    def loss_scale(self):
+        """Current dynamic loss scale: a float, or (per-loss mode,
+        ``PrecisionConfig.num_losses > 1``) a list of one scale per loss."""
+        s = jax.device_get(self._scaler_state["scale"])
+        if getattr(s, "ndim", 0):
+            return [float(v) for v in s]
+        return float(s)
 
     @property
     def mesh(self):

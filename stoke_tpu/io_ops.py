@@ -55,6 +55,14 @@ def _is_multiprocess() -> bool:
     return jax.process_count() > 1
 
 
+def _writer_rank(config: CheckpointConfig) -> int:
+    """The process that writes consolidated payloads + metadata (reference
+    ``DDPIO._save_rank`` / OSS ``consolidate_state_dict(recipient_rank)``,
+    io_ops.py:551-623).  Modulo process count so a config written for a
+    larger pod degrades to a valid rank instead of never writing."""
+    return int(config.save_rank) % max(jax.process_count(), 1)
+
+
 def _gather_to_host(tree: Any) -> Any:
     """Device pytree → host numpy pytree, gathering shards across hosts when
     needed (the consolidation step the reference implements per-backend,
@@ -71,13 +79,16 @@ def _flat_arrays(tree: Any):
     return leaves, treedef
 
 
-def _save_consolidated(tag_dir: str, state: Dict[str, Any]) -> None:
+def _save_consolidated(
+    tag_dir: str, state: Dict[str, Any], writer: int = 0
+) -> None:
     """One ``.npz`` per state tree, leaves in flatten order (restore relies on
     the target structure, so no treedef serialization is needed).  Multi-host:
-    every process gathers (a collective), only process 0 writes."""
+    every process gathers (a collective), only the ``writer`` process (config
+    ``save_rank``) writes."""
     for key, tree in state.items():
         host = _gather_to_host(tree)
-        if jax.process_index() != 0:
+        if jax.process_index() != writer:
             continue
         leaves, _ = _flat_arrays(host)
         np.savez(
@@ -169,9 +180,11 @@ def save_checkpoint(
     """Write one logical checkpoint; returns the tag directory path.
 
     Reference flow (io_ops.py:160-243 + per-backend wrappers :551-703):
-    barrier → gather/consolidate → write (rank 0 for consolidated, all ranks
-    for sharded) → barrier.  Metadata (counters/status/extras) is written by
-    process 0 only.  ``grad_buf`` (the partial accumulation window) is saved
+    barrier → gather/consolidate → write (the ``save_rank`` writer for
+    consolidated, all ranks for sharded) → barrier.  Metadata
+    (counters/status/extras) is written by the ``save_rank`` writer only
+    (reference ``DDPIO._save_rank``, io_ops.py:551-623).  ``grad_buf``
+    (the partial accumulation window) is saved
     too so a mid-window resume loses no gradient mass — the reference cannot
     do this (torch ``.grad`` is not in ``state_dict``).
     """
@@ -186,8 +199,9 @@ def save_checkpoint(
         # Released on ANY failure before the background thread takes over
         # (the thread then owns the release).
         _INFLIGHT_TAGS.add(tag_dir)
+    writer = _writer_rank(config)
     try:
-        if jax.process_index() == 0:
+        if jax.process_index() == writer:
             os.makedirs(tag_dir, exist_ok=True)
         _barrier()
     except BaseException:
@@ -201,9 +215,10 @@ def save_checkpoint(
     if grad_buf is not None:
         state["grad_buf"] = grad_buf
     def _write_meta_files(fmt_value: str) -> None:
-        """meta.json + extras.pkl — process 0 only; shared by the sync and
-        async paths so the metadata schema can never drift between them."""
-        if jax.process_index() != 0:
+        """meta.json + extras.pkl — the ``save_rank`` writer only; shared by
+        the sync and async paths so the metadata schema can never drift
+        between them."""
+        if jax.process_index() != writer:
             return
         meta = {
             "format": fmt_value,
@@ -218,7 +233,7 @@ def save_checkpoint(
                 pickle.dump(extras, f)
 
     def _write_meta():
-        if jax.process_index() == 0:
+        if jax.process_index() == writer:
             _write_meta_files(config.format.value)
             _prune_old(root, name, config.max_to_keep)
             unrolled_print(f"Saved checkpoint {tag_dir}")
@@ -232,7 +247,7 @@ def save_checkpoint(
         # meta.json is written last — and, multi-process, only after the
         # global commit — so a crash mid-save never leaves a loadable
         # partial tag (load requires meta.json).
-        is_proc0 = jax.process_index() == 0
+        is_writer = jax.process_index() == writer
         if config.format is CheckpointFormat.sharded:
             # orbax AsyncCheckpointer: device→host copy on this thread,
             # sharded tensorstore writes + cross-host commit in background
@@ -263,7 +278,7 @@ def save_checkpoint(
                 raise
 
             def _write_payload():
-                if not is_proc0:
+                if not is_writer:
                     return
                 for key, tree in host_state.items():
                     leaves, _ = _flat_arrays(tree)
@@ -283,7 +298,7 @@ def save_checkpoint(
                 # checkpoint — leave the in-flight set BEFORE pruning so it
                 # counts toward its own keep window
                 _INFLIGHT_TAGS.discard(tag_dir)
-                if is_proc0:
+                if is_writer:
                     _prune_old(root, name, config.max_to_keep)
                     unrolled_print(f"Saved checkpoint {tag_dir} (async)")
             except BaseException as e:  # surfaced by wait_for_saves()
@@ -291,7 +306,7 @@ def save_checkpoint(
                 # load without meta.json).  A failure AFTER meta.json exists
                 # (e.g. a transient error inside _prune_old) leaves the
                 # complete, loadable checkpoint in place.
-                if is_proc0 and not os.path.exists(
+                if is_writer and not os.path.exists(
                     os.path.join(tag_dir, "meta.json")
                 ):
                     shutil.rmtree(tag_dir, ignore_errors=True)
@@ -309,7 +324,7 @@ def save_checkpoint(
             raise
         return tag_dir
     if config.format is CheckpointFormat.consolidated:
-        _save_consolidated(tag_dir, state)
+        _save_consolidated(tag_dir, state, writer)
     else:
         _save_sharded(tag_dir, state)
     _write_meta()

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import Callable
+from typing import Callable, Optional
 
 import flax.linen as nn
 import jax
@@ -115,6 +115,16 @@ class BertEncoder(nn.Module):
     TPU-native counterpart of the reference's DeepSpeed PLD passthrough
     (configs.py:375-388, distributed.py:876-896); needs the ``layer_drop``
     rng stream (pass ``model_rng_keys=("dropout", "layer_drop")`` to Stoke).
+
+    The reference PLD additionally exposes a theta/gamma TIME schedule
+    (``DeepspeedPLDConfig``, configs.py:375-388): the global keep ratio
+    warms from 1 toward ``theta`` as ``theta_bar(t) = (1-theta) *
+    exp(-gamma*t) + theta``.  Set ``layer_drop_theta``/``layer_drop_gamma``
+    and pass the current optimizer step as the ``global_step`` call kwarg
+    (a traced scalar, so the scanned multi-step paths can feed a per-step
+    value); the depth-linear drop fraction then becomes
+    ``(1 - theta_bar(t)) * (i+1)/N``.  Without ``global_step`` (or with
+    ``layer_drop_theta=None``) the static ``layer_drop_rate`` applies.
     """
 
     vocab_size: int
@@ -124,10 +134,12 @@ class BertEncoder(nn.Module):
     attention_fn: Callable = dense_attention
     remat: bool = False
     layer_drop_rate: float = 0.0
+    layer_drop_theta: Optional[float] = None
+    layer_drop_gamma: float = 0.001
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
-                 train: bool = True):
+                 train: bool = True, global_step=None):
         B, L = input_ids.shape
         h = nn.Embed(self.vocab_size, self.size.hidden, name="tok_emb")(input_ids)
         pos = jnp.arange(L)[None, :]
@@ -146,18 +158,41 @@ class BertEncoder(nn.Module):
         block = TransformerBlock
         if self.remat:
             block = nn.remat(TransformerBlock, static_argnums=(3,))
+        pld_on = train and (
+            self.layer_drop_rate > 0.0 or self.layer_drop_theta is not None
+        )
         drop_keys = None
-        if self.layer_drop_rate > 0.0 and train:
+        drop_frac = None
+        if pld_on:
             drop_keys = jax.random.split(
                 self.make_rng("layer_drop"), self.size.num_layers
             )
+            if self.layer_drop_theta is not None and global_step is None:
+                raise ValueError(
+                    "Stoke -- layer_drop_theta is set (PLD theta/gamma time "
+                    "schedule) but the forward was called without the "
+                    "global_step kwarg; the schedule would silently never "
+                    "engage.  Pass global_step=<optimizer step> (a traced "
+                    "scalar), or use the static layer_drop_rate instead."
+                )
+            if self.layer_drop_theta is not None:
+                # reference theta/gamma schedule (DeepspeedPLDConfig,
+                # configs.py:375-388): keep ratio decays 1 -> theta
+                theta = jnp.float32(self.layer_drop_theta)
+                theta_bar = (1.0 - theta) * jnp.exp(
+                    -jnp.float32(self.layer_drop_gamma)
+                    * jnp.asarray(global_step, jnp.float32)
+                ) + theta
+                drop_frac = 1.0 - theta_bar
+            else:
+                drop_frac = jnp.float32(self.layer_drop_rate)
         for i in range(self.size.num_layers):
             h_new = block(
                 self.size.hidden, self.size.heads, self.size.ff,
                 self.dropout_rate, self.attention_fn, name=f"layer_{i}",
             )(h, bias, not train)
             if drop_keys is not None:
-                keep_p = 1.0 - self.layer_drop_rate * (i + 1) / self.size.num_layers
+                keep_p = 1.0 - drop_frac * (i + 1) / self.size.num_layers
                 keep = jax.random.bernoulli(drop_keys[i], keep_p)
                 h = jnp.where(keep, h_new, h)
             else:
@@ -176,16 +211,19 @@ class BertForSequenceClassification(nn.Module):
     attention_fn: Callable = dense_attention
     remat: bool = False
     layer_drop_rate: float = 0.0
+    layer_drop_theta: Optional[float] = None
+    layer_drop_gamma: float = 0.001
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
-                 train: bool = True):
+                 train: bool = True, global_step=None):
         size = BERT_SIZES[self.size_name]
         h = BertEncoder(
             self.vocab_size, size, self.max_len, self.dropout_rate,
             self.attention_fn, self.remat, self.layer_drop_rate,
+            self.layer_drop_theta, self.layer_drop_gamma,
             name="encoder",
-        )(input_ids, attention_mask, token_type_ids, train)
+        )(input_ids, attention_mask, token_type_ids, train, global_step)
         cls = nn.tanh(nn.Dense(size.hidden, name="pooler")(h[:, 0]))
         cls = nn.Dropout(self.dropout_rate)(cls, deterministic=not train)
         return nn.Dense(self.num_classes, name="classifier")(cls)

@@ -347,6 +347,22 @@ class StokeStatus:
                 and not isinstance(s["grad_clip"], (ClipGradConfig, ClipGradNormConfig)),
                 "grad_clip must be ClipGradConfig, ClipGradNormConfig, or None",
             ),
+            # per-loss scalers are an fp16 feature (reference: Apex
+            # num_losses configures amp loss scalers, fp16.py:656-691;
+            # full/bf16 have no scaler to multiply)
+            (
+                lambda s: (
+                    (pc := self._configs.get("PrecisionConfig")) is not None
+                    and pc.num_losses != 1
+                    and (
+                        pc.num_losses < 1
+                        or s["precision"] is not PrecisionOptions.fp16
+                    )
+                ),
+                "PrecisionConfig.num_losses > 1 (per-loss scalers) requires "
+                "precision='fp16' and num_losses >= 1 — reference Apex "
+                "num_losses, fp16.py:656-691",
+            ),
             # sharding ladder legality (reference status.py:239-263):
             # SDDP requires OSS (status.py:240-243)
             (

@@ -100,6 +100,53 @@ def test_bert_layer_drop(rng):
     np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))
 
 
+def test_bert_pld_theta_gamma_schedule(rng):
+    """Reference PLD theta/gamma TIME schedule (DeepspeedPLDConfig,
+    configs.py:375-388): theta_bar(t) = (1-theta)*exp(-gamma*t) + theta.
+    At t=0 nothing drops (keep ratio 1); as t grows the drop fraction
+    approaches 1-theta, so train-mode forwards become rng-dependent."""
+    model = bert_tiny(
+        dropout_rate=0.0, layer_drop_theta=0.5, layer_drop_gamma=0.1
+    )
+    ids, mask = bert_inputs(rng)
+    v = init_module(model, jax.random.PRNGKey(0), ids, mask, train=False)
+
+    def fwd(key, step):
+        return model.apply(
+            v, ids, mask, train=True, global_step=step,
+            rngs={"layer_drop": key},
+        )
+
+    # t=0: theta_bar = 1 -> no layers drop, any rng gives the eval output
+    e = model.apply(v, ids, mask, train=False)
+    a0 = fwd(jax.random.PRNGKey(1), 0)
+    b0 = fwd(jax.random.PRNGKey(2), 0)
+    np.testing.assert_allclose(np.asarray(a0), np.asarray(e), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(b0), np.asarray(e), atol=1e-5)
+    # late t: drop fraction ~ 1-theta = 0.5 -> rng-dependent forwards
+    a1 = fwd(jax.random.PRNGKey(1), 10_000)
+    b1 = fwd(jax.random.PRNGKey(2), 10_000)
+    assert not np.allclose(np.asarray(a1), np.asarray(b1))
+    # global_step is traced: the schedule works under jit with step as an
+    # argument (the scanned multi-step paths rely on this)
+    jitted = jax.jit(
+        lambda step, key: model.apply(
+            v, ids, mask, train=True, global_step=step,
+            rngs={"layer_drop": key},
+        )
+    )
+    j0 = jitted(jnp.int32(0), jax.random.PRNGKey(1))
+    np.testing.assert_allclose(np.asarray(j0), np.asarray(e), atol=1e-5)
+    # theta floor: at extreme t the drop fraction saturates at 1-theta,
+    # never 1 — the network still runs and stays finite
+    assert np.isfinite(np.asarray(fwd(jax.random.PRNGKey(3), 10**9))).all()
+    # misconfiguration guard: theta set but no global_step passed in train
+    # mode would silently never engage the schedule — it must raise
+    with pytest.raises(ValueError, match="global_step"):
+        model.apply(v, ids, mask, train=True,
+                    rngs={"layer_drop": jax.random.PRNGKey(0)})
+
+
 def test_bert_remat_matches(rng):
     """Activation-checkpointed encoder must compute identical outputs."""
     ids, mask = bert_inputs(rng)

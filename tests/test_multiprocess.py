@@ -123,6 +123,14 @@ def test_consolidated_save_multiprocess(tmp_path):
     run_workers("consolidated_save", str(tmp_path))
 
 
+def test_save_rank_multiprocess(tmp_path):
+    """save_rank=1: the non-zero process writes the consolidated payload +
+    metadata (reference DDPIO._save_rank, io_ops.py:551-623); barriers must
+    not deadlock with a non-default writer, and out-of-range ranks degrade
+    via modulo."""
+    run_workers("save_rank", str(tmp_path))
+
+
 def test_sharded_save_multiprocess(tmp_path):
     """fsdp + orbax sharded save/load across 2 processes."""
     run_workers("sharded_save", str(tmp_path))

@@ -1,0 +1,178 @@
+"""Per-loss fp16 scalers (reference Apex ``num_losses`` /
+``amp.scale_loss(..., loss_id)``, fp16.py:545-579, :656-691).
+
+TPU translation: one shared forward, one VJP backward per loss seeded with
+that loss's own dynamic scale, immediate unscale into the fp32 buffer,
+per-loss overflow flags driving a vectorized scaler update at apply.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from stoke_tpu import PrecisionConfig, Stoke, StokeOptimizer
+from stoke_tpu.status import StokeStatus, StokeValidationError
+
+
+def linear_model(params, x):
+    return x @ params["w"] + params["b"]
+
+
+def two_losses(out, y):
+    return (jnp.mean((out - y) ** 2), 0.01 * jnp.mean(out**2))
+
+
+def make_stoke(num_losses=2, loss=two_losses, scaler_kwargs=None, **kw):
+    params = {
+        "w": jnp.zeros((4, 2), jnp.float32),
+        "b": jnp.zeros((2,), jnp.float32),
+    }
+    kw.setdefault("batch_size_per_device", 8)
+    kw.setdefault("verbose", False)
+    kw.setdefault("precision", "fp16")
+    cfgs = list(kw.pop("configs", []))
+    cfgs.append(PrecisionConfig(num_losses=num_losses, **(scaler_kwargs or {})))
+    return Stoke(
+        model=linear_model,
+        optimizer=StokeOptimizer(
+            optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.2}
+        ),
+        loss=loss,
+        params=params,
+        configs=cfgs,
+        **kw,
+    )
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def batch(rng, n=8):
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    return x, (x @ np.ones((4, 2), np.float32)).astype(np.float32)
+
+
+def test_num_losses_requires_fp16():
+    with pytest.raises(StokeValidationError, match="num_losses"):
+        StokeStatus(
+            batch_size_per_device=8,
+            precision="bf16",
+            configs=[PrecisionConfig(num_losses=2)],
+        )
+    with pytest.raises(StokeValidationError, match="num_losses"):
+        StokeStatus(
+            batch_size_per_device=8,
+            precision="fp16",
+            configs=[PrecisionConfig(num_losses=0)],
+        )
+    # fp16 + num_losses>1 is the legal per-loss configuration
+    StokeStatus(
+        batch_size_per_device=8,
+        precision="fp16",
+        configs=[PrecisionConfig(num_losses=2)],
+    )
+
+
+def test_scaler_state_is_vector(rng):
+    s = make_stoke(num_losses=2)
+    assert s.scaler["scale"].shape == (2,)
+    assert s.scaler["growth_count"].shape == (2,)
+    assert s.scaler["finite"].shape == (2,)
+    assert s.loss_scale == [2.0**16, 2.0**16]
+
+
+def test_per_loss_matches_single_scaler_training(rng):
+    """With no overflow, per-loss scaling is mathematically the single-
+    scaler objective (scale cancels per loss); params must match."""
+    s1 = make_stoke(num_losses=1)
+    s2 = make_stoke(num_losses=2)
+    for _ in range(5):
+        x, y = batch(rng)
+        for s in (s1, s2):
+            out = s.model(x)
+            l = s.loss(out, y)
+            s.backward(l)
+            s.step()
+    # fp16 rounds at different points in the two paths (scaled-objective
+    # backward vs scale-seeded VJP), so parity is at fp16 epsilon, not f32
+    np.testing.assert_allclose(
+        np.asarray(s1.params["w"]), np.asarray(s2.params["w"]),
+        rtol=2e-3, atol=2e-4,
+    )
+    # the classic GradScaler warm-up backoff (first-step overflow at the
+    # 2**16 init scale) must hit both modes identically
+    assert s2.skipped_optimizer_steps == s1.skipped_optimizer_steps
+
+
+def test_wrong_loss_count_raises(rng):
+    s = make_stoke(num_losses=3)  # loss() returns 2 leaves
+    x, y = batch(rng)
+    out = s.model(x)
+    with pytest.raises(ValueError, match="num_losses"):
+        s.loss(out, y)
+
+
+def test_per_loss_overflow_isolated(rng):
+    """An overflow in loss 1 backs off ONLY scale[1], skips the step, and
+    leaves loss 0's scale untouched (the whole point of per-loss scalers —
+    reference fp16.py:545-579)."""
+
+    def exploding_second(out, y):
+        # grad of loss1 ~ 1e35 → inf once seeded with the 2^16 scale
+        return (jnp.mean((out - y) ** 2), jnp.float32(1e35) * jnp.mean(out * y))
+
+    # init_scale small enough that the healthy mse loss does NOT overflow
+    # at step 1 (at the default 2**16 its own cotangents exceed fp16 max)
+    s = make_stoke(num_losses=2, loss=exploding_second,
+                   scaler_kwargs={"init_scale": 2.0**8})
+    x, y = batch(rng)
+    out = s.model(x)
+    l = s.loss(out, y)
+    s.backward(l)
+    s.step()
+    scales = s.loss_scale
+    assert scales[0] == 2.0**8, "healthy loss's scale must not back off"
+    assert scales[1] == 2.0**7, "overflowing loss's scale must halve"
+    assert s.skipped_optimizer_steps == 1
+    # params unchanged: the step was skipped
+    np.testing.assert_array_equal(np.asarray(s.params["w"]), 0.0)
+
+
+def test_dropped_pending_loss_leaves_scaler_untouched(rng):
+    """backward()'s 'no backward -> no gradient contribution' invariant
+    extends to per-loss overflow flags: a probe loss() whose grads overflow
+    but is never committed with backward() must not skip the next step or
+    back off any scale (review r4: flags commit at backward() time)."""
+
+    def exploding_second(out, y):
+        return (jnp.mean((out - y) ** 2), jnp.float32(1e35) * jnp.mean(out * y))
+
+    s = make_stoke(num_losses=2, loss=exploding_second,
+                   scaler_kwargs={"init_scale": 2.0**8})
+    x, y = batch(rng)
+    out = s.model(x)
+    s.loss(out, y)  # overflows loss 1 — but never committed with backward()
+    assert s.loss_scale == [2.0**8, 2.0**8]
+    assert bool(np.all(np.asarray(s.scaler["finite"])))
+    assert s.backward_steps == 0
+
+
+def test_per_loss_through_train_step_and_window(rng):
+    """The fused train_step and scan-window paths thread the per-loss
+    scaler state identically to the 4-call path."""
+    s = make_stoke(num_losses=2)
+    x, y = batch(rng)
+    s.train_step(x, (y,))
+    assert s.optimizer_steps == 1
+    assert s.scaler["scale"].shape == (2,)
+    s4 = make_stoke(num_losses=2, grad_accum=2)
+    xs = np.stack([batch(rng)[0] for _ in range(2)])
+    ys = np.stack([batch(rng)[1] for _ in range(2)])
+    s4.train_step_window(xs, (ys,))
+    assert s4.optimizer_steps == 1
+    assert s4.scaler["scale"].shape == (2,)
