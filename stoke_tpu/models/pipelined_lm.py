@@ -68,6 +68,9 @@ class PipelinedLM(ModelAdapter):
             bubble shrinks from (S-1)/(M+S-1) to (S-1)/(rounds·M+S-1)).
         remat: rematerialize each per-tick stage application (1F1B-style
             activation memory).
+        data_axis: optional mesh axis for dp×pp composition — the batch dim
+            of the microbatch stream shards over it (mesh must then carry
+            both axes, e.g. ``MeshConfig(axes=("data", "stage"), ...)``).
 
     Usage:
         adapter = PipelinedLM(mesh, vocab_size=..., num_microbatches=4)
@@ -89,6 +92,7 @@ class PipelinedLM(ModelAdapter):
         stage_axis: str = "stage",
         rounds: int = 1,
         remat: bool = False,
+        data_axis: Optional[str] = None,
     ):
         self.mesh = mesh
         self.vocab_size = vocab_size
@@ -105,13 +109,17 @@ class PipelinedLM(ModelAdapter):
         self._piped = pipeline(
             lambda p, x: self._stage_module.apply({"params": p}, x),
             mesh, stage_axis, rounds=self.rounds, remat=remat,
+            data_axis=data_axis,
         )
 
     # ------------------------------------------------------------------ #
 
     def init(self, rng) -> dict:
         """Host-side initialization of embed + S stage trees + head."""
-        cpu = jax.devices("cpu")[0]
+        # local_devices, not devices: in a multi-process run the global
+        # device list leads with process 0's devices, which other processes
+        # cannot address (same fix as utils/init.py)
+        cpu = jax.local_devices(backend="cpu")[0]
         with jax.default_device(cpu):
             k_embed, k_pos, k_head, *k_stages = jax.random.split(
                 rng, 3 + self.num_stages

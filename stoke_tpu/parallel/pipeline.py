@@ -78,6 +78,7 @@ def pipeline(
     *,
     rounds: int = 1,
     remat: bool = False,
+    data_axis: Optional[str] = None,
 ) -> Callable:
     """Build a pipelined apply from a single-stage function.
 
@@ -94,6 +95,13 @@ def pipeline(
         remat: rematerialize each per-tick stage application
             (``jax.checkpoint``) so backward residuals hold only wire
             activations — the 1F1B activation-memory profile.
+        data_axis: optional mesh axis for dp×pp composition: the microbatch
+            stream's BATCH dim (axis 1 of ``[M, micro_batch, ...]`` leaves)
+            shards over it, so each data-parallel group runs the same
+            pipeline schedule on its batch slice (stage ``ppermute``s stay
+            within a group; gradient all-reduce over ``data_axis`` is
+            GSPMD's job at the consumer).  Without it, extra mesh axes see
+            the stream replicated.
 
     Returns ``pipelined(stacked_params, xs)`` where ``stacked_params``
     leaves carry a leading stage dimension [L, ...] and ``xs`` is the
@@ -205,14 +213,24 @@ def pipeline(
         param_specs = jax.tree_util.tree_map(
             lambda a: P(None, axis_name, *([None] * (a.ndim - 2))), grouped
         )
-        xs_specs = jax.tree_util.tree_map(lambda a: P(), xs)
+        d = data_axis  # None -> batch dim replicated over extra axes
+
+        def _xs_spec(a):
+            if d is None or a.ndim < 2:
+                return P()
+            return P(None, d, *([None] * (a.ndim - 2)))
+
+        def _out_spec(a):
+            if d is None or a.ndim < 2:
+                return P(axis_name, *([None] * (a.ndim - 1)))
+            return P(axis_name, d, *([None] * (a.ndim - 2)))
+
+        xs_specs = jax.tree_util.tree_map(_xs_spec, xs)
         M = jax.tree_util.tree_leaves(xs)[0].shape[0]
         # match the emit path: reduce-scattered outputs are sharded over the
         # stage axis on the microbatch dim (same global array)
         out_specs = (
-            jax.tree_util.tree_map(
-                lambda a: P(axis_name, *([None] * (a.ndim - 1))), xs
-            )
+            jax.tree_util.tree_map(_out_spec, xs)
             if M % S == 0
             else xs_specs
         )

@@ -46,7 +46,10 @@ def init_module(module, rng, *args, **kwargs) -> Any:
         variables = init_module(model, jax.random.PRNGKey(0), dummy_batch,
                                 train=False)
     """
-    cpu = jax.devices("cpu")[0]
+    # local_devices, not devices: in a multi-process run the global device
+    # list leads with process 0's devices, which other processes cannot
+    # address (device_put would raise "non-addressable device")
+    cpu = jax.local_devices(backend="cpu")[0]
     rng = jax.device_put(rng, cpu)
     with jax.default_device(cpu):
         return jax.jit(lambda r: module.init(r, *args, **kwargs))(rng)

@@ -212,6 +212,109 @@ def main():
         b = multihost_utils.process_allgather(s2.params["w"], tiled=True)
         np.testing.assert_allclose(b, w_at_save, rtol=1e-6)
 
+    elif SCENARIO == "composed_mesh":
+        # pod-style composed meshes across 2 PROCESSES x 4 local devices
+        # (VERDICT r3 item 5): dp x tp over the global 8-device mesh, then
+        # a dp x seq ring and a dp x pp pipeline on the same global pool —
+        # the multi-host version of the dryrun's composed scenarios.
+        # jax.devices() is process-major (d0-d3 = proc 0, d4-d7 = proc 1),
+        # so the naive reshape would keep every NON-data axis inside one
+        # process; the interleaved layout below puts consecutive tp/seq/
+        # stage neighbors on DIFFERENT processes, forcing the TP
+        # all-reduces and the ring/stage ppermutes across the gRPC
+        # boundary (the coverage this scenario exists for)
+        import jax.numpy as jnp
+        import optax
+        from jax.sharding import Mesh
+
+        from stoke_tpu import MeshConfig, PartitionRulesConfig
+        from stoke_tpu.models import (
+            BertForSequenceClassification,
+            bert_tensor_parallel_rules,
+        )
+        from stoke_tpu.utils import init_module
+
+        r = np.random.default_rng(0)
+        model = BertForSequenceClassification(
+            vocab_size=64, num_classes=2, size_name="tiny", max_len=32,
+            dropout_rate=0.0,
+        )
+        n_global = len(jax.devices())
+        assert n_global == 8 and jax.process_count() == NPROC
+        # interleave: [d0,d4,d1,d5,d2,d6,d3,d7] — consecutive devices on
+        # alternating processes, so any axis of size >= 2 laid out over
+        # this order crosses the process boundary
+        interleaved = np.asarray(jax.devices()).reshape(NPROC, -1).T.flatten()
+        ids_local = r.integers(1, 64, size=(n_global, 16)).astype(np.int32)
+        # per-process slice of the global batch (contiguous rows)
+        local = n_global // NPROC
+        sl = slice(PID * local, (PID + 1) * local)
+        variables = init_module(
+            model, jax.random.PRNGKey(0), ids_local[:2],
+            np.ones((2, 16), np.int32), train=False,
+        )
+        s = Stoke(
+            model=model,
+            optimizer=StokeOptimizer(
+                optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.1}
+            ),
+            loss=lambda lg, y: optax.softmax_cross_entropy_with_integer_labels(
+                lg, y
+            ).mean(),
+            params=variables,
+            batch_size_per_device=1,
+            distributed="dp",
+            configs=[
+                DistributedInitConfig(
+                    coordinator_address=f"localhost:{PORT}",
+                    num_processes=NPROC,
+                    process_id=PID,
+                ),
+                # tp pairs (d0,d4), (d1,d5), ... — every TP all-reduce
+                # crosses gRPC
+                MeshConfig(axes=("data", "model"), shape=(4, 2),
+                           devices=list(interleaved)),
+                PartitionRulesConfig(rules=bert_tensor_parallel_rules()),
+            ],
+            model_train_kwargs={"train": True},
+            model_eval_kwargs={"train": False},
+            verbose=False,
+        )
+        s.train_step(
+            (ids_local[sl], np.ones((local, 16), np.int32)),
+            np.zeros((local,), np.int64),
+        )
+        s.block_until_ready()
+        assert s.optimizer_steps == 1
+
+        # dp x seq ring attention over the same global pool
+        from stoke_tpu.ops import ring_attention
+
+        # seq pairs (d0,d4), ... — ring ppermutes cross gRPC
+        mesh_sp = Mesh(interleaved.reshape(-1, 2), ("data", "seq"))
+        q = jnp.asarray(r.normal(size=(2, 2, 8, 4)).astype(np.float32))
+        jax.grad(
+            lambda q: jnp.sum(
+                ring_attention(q, q, q, mesh=mesh_sp, axis_name="seq") ** 2
+            )
+        )(q).block_until_ready()
+
+        # dp x pp pipeline: stage ppermutes cross the process boundary
+        from stoke_tpu.parallel import pipeline, stack_stage_params
+
+        # stage rings [d0,d4,d1,d5] / [d2,d6,d3,d7] — every stage-to-stage
+        # ppermute hop crosses gRPC
+        mesh_pp = Mesh(interleaved.reshape(2, 4), ("data", "stage"))
+        stages = stack_stage_params(
+            [{"w": jnp.eye(4) * 0.5} for _ in range(4)]
+        )
+        piped = pipeline(
+            lambda p, x: jnp.tanh(x @ p["w"]), mesh_pp, "stage",
+            data_axis="data",
+        )
+        xs = jnp.asarray(r.normal(size=(4, 2, 4)).astype(np.float32))
+        jax.grad(lambda p: jnp.sum(piped(p, xs) ** 2))(stages)
+
     elif SCENARIO == "loader":
         # multi-process DataLoader REQUIRES a distributed sampler
         # (reference stoke.py:822-826); with one, processes see disjoint

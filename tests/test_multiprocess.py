@@ -144,6 +144,14 @@ def test_async_sharded_save_multiprocess(tmp_path):
     run_workers("async_sharded_save", str(tmp_path))
 
 
+def test_composed_mesh_multiprocess(tmp_path):
+    """Pod-style composed meshes across 2 processes × 4 devices: dp×tp
+    train step (TP collectives cross the process boundary), dp×seq ring
+    attention, dp×pp pipeline — the multi-host counterpart of the dryrun's
+    composed scenarios (VERDICT r3 item 5)."""
+    run_workers("composed_mesh", str(tmp_path))
+
+
 def test_loader_sampler_enforcement_and_sharding(tmp_path):
     """Sampler required multi-process; shards are disjoint and cover all."""
     run_workers("loader", str(tmp_path))

@@ -360,3 +360,115 @@ def test_pipeline_indivisible_M_falls_back_to_psum(rng, stage_mesh):
     piped = pipeline(stage_fn, stage_mesh, "stage")
     txt = jax.jit(piped).lower(stacked, xs).as_text()
     assert "all_reduce" in txt
+
+
+# ------------------------- dp x pp composition --------------------------- #
+
+
+@pytest.fixture
+def dp_pp_mesh(devices):
+    # 2 data-parallel groups x 4 pipeline stages over the 8 simulated devices
+    return Mesh(
+        np.asarray(jax.devices("cpu")[:8]).reshape(2, S), ("data", "stage")
+    )
+
+
+def test_dp_pp_composed_matches_sequential(rng, dp_pp_mesh):
+    """dp x pp (VERDICT r4 item 5): the batch dim shards over 'data', the
+    stage rotation stays within each data group; forward AND gradients must
+    equal sequential stage application on the full global batch."""
+    trees, stacked = make_params(rng)
+    xs = jnp.asarray(rng.normal(size=(M, B, D)).astype(np.float32))
+    piped = pipeline(stage_fn, dp_pp_mesh, "stage", data_axis="data")
+    out = piped(stacked, xs)
+    ref = sequential(trees, xs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
+                               atol=2e-6)
+    # batch dim really is sharded over data (M=6 is not stage-divisible, so
+    # the microbatch dim takes the replicating psum emit path)
+    assert out.sharding.spec[1] == "data", out.sharding.spec
+    # with stage-divisible M the reduce-scatter path shards BOTH dims
+    xs8 = jnp.asarray(rng.normal(size=(2 * S, B, D)).astype(np.float32))
+    out8 = piped(stacked, xs8)
+    np.testing.assert_allclose(
+        np.asarray(out8), np.asarray(sequential(trees, xs8)),
+        rtol=2e-5, atol=2e-6,
+    )
+    spec8 = out8.sharding.spec
+    assert spec8[0] == "stage" and spec8[1] == "data", spec8
+
+    def loss_piped(p):
+        return jnp.sum(piped(p, xs) ** 2)
+
+    def loss_seq(p_trees):
+        return jnp.sum(sequential(p_trees, xs) ** 2)
+
+    g_p = jax.grad(loss_piped)(stacked)
+    g_s = stack_stage_params(
+        [g for g in jax.grad(loss_seq)(trees)]
+    )
+    for a, b in zip(jax.tree_util.tree_leaves(g_p),
+                    jax.tree_util.tree_leaves(g_s)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=2e-5)
+
+
+def test_dp_pp_circular_composed(rng, dp_pp_mesh):
+    """Circular schedule composes with the data axis identically."""
+    trees, stacked = make_params(rng)
+    # 8 virtual stages over 4 devices (rounds=2): reuse the 4 stage trees
+    # twice for an L=8 reference
+    stacked8 = stack_stage_params(trees + trees)
+    xs = jnp.asarray(rng.normal(size=(M, B, D)).astype(np.float32))
+    piped = pipeline(stage_fn, dp_pp_mesh, "stage", rounds=2,
+                     data_axis="data")
+    out = piped(stacked8, xs)
+    ref = sequential(trees + trees, xs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
+                               atol=2e-6)
+
+
+def test_pipelined_lm_train_steps_dp_pp(rng, dp_pp_mesh):
+    """PipelinedLM on a composed ("data","stage") mesh through the
+    train_steps multi-step scan: the full dp x pp training integration
+    (VERDICT r4: pipeline wired through train_steps)."""
+    import optax
+
+    from stoke_tpu import (
+        MeshConfig,
+        PartitionRulesConfig,
+        Stoke,
+        StokeOptimizer,
+    )
+    from stoke_tpu.models import (
+        PipelinedLM,
+        causal_lm_loss,
+        pipeline_parallel_rules,
+    )
+
+    adapter = PipelinedLM(
+        dp_pp_mesh, vocab_size=32, size_name="tiny", max_len=32,
+        num_microbatches=2, layers_per_stage=1, data_axis="data",
+    )
+    s = Stoke(
+        model=adapter,
+        optimizer=StokeOptimizer(
+            optimizer=optax.adam, optimizer_kwargs={"learning_rate": 3e-3}
+        ),
+        loss=causal_lm_loss,
+        params=adapter.init(jax.random.PRNGKey(0)),
+        batch_size_per_device=1,
+        device="cpu",
+        distributed="dp",
+        configs=[
+            MeshConfig(axes=("data", "stage"), shape=(2, S)),
+            PartitionRulesConfig(rules=pipeline_parallel_rules()),
+        ],
+        verbose=False,
+    )
+    seq = np.tile(np.arange(16, dtype=np.int32), 2)[None, :].repeat(4, 0)
+    seqs = np.stack([seq] * 6)  # 6 optimizer steps in ONE dispatch
+    reports = s.train_steps(seqs, (seqs,))
+    losses = np.asarray(jax.device_get(reports)).reshape(6, -1).mean(1)
+    assert s.optimizer_steps == 6
+    assert losses[-1] < losses[0]
