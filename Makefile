@@ -7,10 +7,13 @@
 CPU_ENV = env PYTHONPATH=$(CURDIR) JAX_PLATFORMS=cpu
 MESH_ENV = $(CPU_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast dryrun bench-smoke tpu-probe
+.PHONY: test test-full test-fast dryrun bench-smoke tpu-probe
 
-test:            ## full suite on the simulated 8-device CPU mesh
+test:            ## default tier (excludes @slow compile-heavy equivalence tests)
 	$(MESH_ENV) python -m pytest tests/ -x -q
+
+test-full:       ## FULL suite incl. @slow (what CI runs)
+	$(MESH_ENV) python -m pytest tests/ -x -q -m ""
 
 test-fast:       ## quick subset (status/facade/data), CPU mesh
 	$(MESH_ENV) python -m pytest tests/test_status.py tests/test_facade.py tests/test_data.py -x -q

@@ -41,12 +41,16 @@ QUEUE = {
                    ["--chunked-ce", "--vocab", "32768",
                     "--lengths", "4096,8192", "--batch", "2"]),
     "bench": ("bench.py", []),
+    # evidence capture for the 0.46x ResNet attack (VERDICT r3 item 2):
+    # batch sweep + HLO op histogram + wall-clock breakdown
+    "profile": ("scripts/profile_capture.py",
+                ["--batches", "128,256,512,1024"]),
     # CPU-safe smoke of the runpy dispatch itself (not part of the default
     # queue): tiny preset, finishes in ~1 min off-chip
     "smoke": ("bench.py", ["--preset", "tiny"]),
 }
 DEFAULT_QUEUE = ("flops_probe", "accuracy", "longcontext", "op_ring",
-                 "chunked_ce", "bench")
+                 "chunked_ce", "bench", "profile")
 
 
 def main():

@@ -81,6 +81,7 @@ def test_matches_dense_causal(impl_name, inner, rng, devices):
 
 
 @pytest.mark.parametrize("inner", INNERS)
+@pytest.mark.slow
 def test_ring_grads_match_dense(inner, rng, devices):
     """Backward pass through the ring must match dense-attention gradients —
     training viability, not just inference.  The flash inner additionally
@@ -104,6 +105,7 @@ def test_ring_grads_match_dense(inner, rng, devices):
 
 
 @pytest.mark.parametrize("impl_name", list(IMPLS))
+@pytest.mark.slow
 def test_flash_inner_grads_causal_masked(impl_name, rng, devices):
     """Flash-inner ring/Ulysses gradients under causal + padding mask — the
     hardest composition (static per-hop causality, rotating key masks,
@@ -232,6 +234,7 @@ def test_flash_rejects_bad_shapes(rng, devices):
         flash_attention(q, q, q, jnp.ones((2, 32), jnp.int32))
 
 
+@pytest.mark.slow
 def test_flash_as_model_attention_fn(rng, devices):
     """make_flash_attention plugs into the BERT encoder."""
     from stoke_tpu import init_module
@@ -255,6 +258,7 @@ def test_flash_as_model_attention_fn(rng, devices):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_bert_with_ring_attention_end_to_end(rng, devices):
     """BertEncoder(attention_fn=ring) trains through the Stoke facade on a
     ("data","seq") mesh — long-context wiring, end to end."""
@@ -362,6 +366,7 @@ def test_inner_auto_falls_back_to_dense_on_awkward_length(rng, devices):
 # ----------------------- zigzag causal ring (balanced) --------------------- #
 
 
+@pytest.mark.slow
 def test_zigzag_ring_matches_dense_causal(rng, devices):
     """Zigzag-layout causal ring (device d holds blocks d and 2n-1-d for
     equal per-hop causal work) matches dense causal attention in values and
@@ -436,6 +441,7 @@ def test_zigzag_permutation_helpers(rng, devices):
         zigzag_ring_attention(q, q, q, mesh=mesh, axis_name="seq")
 
 
+@pytest.mark.slow
 def test_gpt_zigzag_end_to_end(rng, devices):
     """GPT on zigzag-ordered tokens (attention_fn=make_zigzag_ring_attention,
     positions=perm) produces exactly the permutation of the natural-order
@@ -476,6 +482,7 @@ def test_gpt_zigzag_end_to_end(rng, devices):
     np.testing.assert_allclose(out_zz, ref[:, perm], rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_gpt_positions_argument(rng):
     """positions=arange reproduces the default; a shifted positions vector
     changes the output (the embedding actually follows it)."""

@@ -173,6 +173,7 @@ def test_loader_no_place_passthrough():
     assert isinstance(b, np.ndarray)
 
 
+@pytest.mark.slow
 def test_loader_torch_workers():
     """Multi-worker host loading through the torch path still yields numpy
     batches in order."""

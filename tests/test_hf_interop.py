@@ -8,6 +8,7 @@ import pytest
 transformers = pytest.importorskip("transformers")
 
 
+@pytest.mark.slow
 def test_hf_flax_bert_trains():
     try:
         from transformers import BertConfig, FlaxBertForSequenceClassification

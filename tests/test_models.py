@@ -24,6 +24,7 @@ def test_basicnn_shapes(rng):
 
 
 @pytest.mark.parametrize("ctor,n_params_min", [(ResNet18, 11e6), (ResNet50, 23e6)])
+@pytest.mark.slow
 def test_resnet_param_counts(ctor, n_params_min):
     from stoke_tpu.utils import tree_count_params
 
@@ -37,6 +38,7 @@ def test_resnet_param_counts(ctor, n_params_min):
     assert "batch_stats" in v  # BN state collection exists
 
 
+@pytest.mark.slow
 def test_resnet_train_updates_batch_stats(rng):
     model = ResNet18(num_classes=10, num_filters=8, cifar_stem=True)
     x = rng.normal(size=(4, 16, 16, 3)).astype(np.float32)
@@ -79,6 +81,7 @@ def test_bert_shapes_and_padding_invariance(rng):
     np.testing.assert_allclose(np.asarray(out[0]), np.asarray(out2[0]), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_bert_layer_drop(rng):
     """PLD: with layer_drop active, train-mode forwards vary by rng; eval is
     deterministic and drop-free."""
@@ -100,6 +103,7 @@ def test_bert_layer_drop(rng):
     np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))
 
 
+@pytest.mark.slow
 def test_bert_pld_theta_gamma_schedule(rng):
     """Reference PLD theta/gamma TIME schedule (DeepspeedPLDConfig,
     configs.py:375-388): theta_bar(t) = (1-theta)*exp(-gamma*t) + theta.
@@ -147,6 +151,7 @@ def test_bert_pld_theta_gamma_schedule(rng):
                     rngs={"layer_drop": jax.random.PRNGKey(0)})
 
 
+@pytest.mark.slow
 def test_bert_remat_matches(rng):
     """Activation-checkpointed encoder must compute identical outputs."""
     ids, mask = bert_inputs(rng)
@@ -158,6 +163,7 @@ def test_bert_remat_matches(rng):
     np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_vit_shapes_and_training(rng):
     from stoke_tpu.models import ViT
 
@@ -228,6 +234,7 @@ def test_gpt_causal_consistency(rng):
     )
 
 
+@pytest.mark.slow
 def test_gpt_trains_causal_lm(rng):
     """GPT learns a trivial next-token pattern through the facade."""
     import optax
@@ -256,6 +263,7 @@ def test_gpt_trains_causal_lm(rng):
     assert last < first * 0.5, (first, last)
 
 
+@pytest.mark.slow
 def test_bert_trains_through_facade_with_pld(rng):
     import optax
 
@@ -288,6 +296,7 @@ def test_bert_trains_through_facade_with_pld(rng):
 # ---------------------- chunked LM-head cross entropy ---------------------- #
 
 
+@pytest.mark.slow
 def test_chunked_ce_matches_full(rng):
     """Chunked CE (scan over sequence chunks, remat) must match full-logits
     CE in values AND gradients (wrt hidden and embedding), including a
@@ -326,6 +335,7 @@ def test_chunked_ce_matches_full(rng):
         )
 
 
+@pytest.mark.slow
 def test_gpt_chunked_head_matches_and_trains(rng):
     """GPT(chunked_head=True) + chunked_causal_lm_loss equals the full-logits
     causal_lm_loss and trains through the facade."""

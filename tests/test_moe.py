@@ -25,6 +25,7 @@ def make_moe(**kw):
     return MoEFFN(hidden=H, ff=FF, num_experts=E, **kw)
 
 
+@pytest.mark.slow
 def test_routing_sends_tokens_to_argmax_expert(rng):
     """With identity-ish experts distinguished by scale, each token's output
     must reflect exactly its argmax expert."""
@@ -91,6 +92,7 @@ def test_expert_parallel_matches_replicated(rng, devices):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow
 def test_gpt_with_moe_layers_and_ep(rng, devices):
     """GPT(moe_num_experts=E): every 2nd block uses the switch MoE; expert
     weights shard over the expert axis and the LM trains."""
@@ -387,6 +389,7 @@ def test_top_k_validation(rng):
         )
 
 
+@pytest.mark.slow
 def test_gpt_moe_top2_trains(rng):
     """GPT with top-2 MoE layers trains end to end and exposes aux losses."""
     from stoke_tpu.models import GPT, causal_lm_loss
@@ -416,6 +419,7 @@ def test_gpt_moe_top2_trains(rng):
     assert aux and float(aux[0]) > 0.0  # live balancing term in state
 
 
+@pytest.mark.slow
 def test_moe_checkpoint_excludes_transient_losses(tmp_path, rng):
     """The sown "losses" collection is transient output: it is excluded from
     checkpoints (so adding/removing sown losses never invalidates old
@@ -447,6 +451,7 @@ def test_moe_checkpoint_excludes_transient_losses(tmp_path, rng):
     assert s2.optimizer_steps == 4
 
 
+@pytest.mark.slow
 def test_legacy_checkpoint_with_losses_collection_loads(tmp_path, rng):
     """A checkpoint saved when the sown 'losses' collection was still
     included in variables (pre-exclusion versions) loads via the fallback

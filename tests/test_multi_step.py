@@ -43,7 +43,9 @@ def _make(devices, grad_accum=1, fsdp=False, precision=None):
     )
 
 
-@pytest.mark.parametrize("grad_accum", [1, 2])
+@pytest.mark.parametrize(
+    "grad_accum", [1, pytest.param(2, marks=pytest.mark.slow)]
+)
 def test_train_steps_matches_eager(devices, rng, grad_accum):
     n_steps = 3
     total = n_steps * grad_accum
@@ -83,6 +85,7 @@ def test_train_steps_matches_eager(devices, rng, grad_accum):
     )
 
 
+@pytest.mark.slow
 def test_train_steps_fsdp_sharded(devices, rng):
     s = _make(devices, grad_accum=2, fsdp=True)
     xs = rng.normal(size=(4, 16, 32, 32, 3)).astype(np.float32)
@@ -103,6 +106,7 @@ def test_train_steps_rejects_bad_stack(devices, rng):
         s.train_steps(xs, (ys,))
 
 
+@pytest.mark.slow
 def test_train_steps_rejects_mid_window(devices, rng):
     s = _make(devices, grad_accum=2)
     x = rng.normal(size=(16, 32, 32, 3)).astype(np.float32)
@@ -128,6 +132,7 @@ def test_crossed_boundary_cadence():
     assert not cb(0, 5, 1)
 
 
+@pytest.mark.slow
 def test_train_steps_auto_save_mid_segment(devices, rng, tmp_path):
     """A save_every_n_steps boundary crossed mid-segment produces a
     checkpoint at the segment end."""
@@ -190,6 +195,7 @@ def test_train_steps_auto_save_mid_segment(devices, rng, tmp_path):
     assert fresh.optimizer_steps == 4
 
 
+@pytest.mark.slow
 def test_train_steps_fp16_scaler_advances(devices, rng):
     s = _make(devices, grad_accum=1, precision="fp16")
     xs = rng.normal(size=(2, 16, 32, 32, 3)).astype(np.float32)
@@ -199,6 +205,7 @@ def test_train_steps_fp16_scaler_advances(devices, rng):
     assert float(s.loss_scale) > 0
 
 
+@pytest.mark.slow
 def test_train_steps_chunked_matches_full(devices, rng):
     """segment_size streams the segment in chunks: counters, params, EMA and
     stacked reports must match the single-dispatch run exactly."""

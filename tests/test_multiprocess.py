@@ -76,6 +76,7 @@ def mp_available():
     return True
 
 
+@pytest.mark.slow
 def test_train_equivalence_across_processes(tmp_path):
     """2-process dp training on per-process batch slices must produce
     identical replicated params on both processes AND match a single-process
@@ -123,6 +124,7 @@ def test_consolidated_save_multiprocess(tmp_path):
     run_workers("consolidated_save", str(tmp_path))
 
 
+@pytest.mark.slow
 def test_save_rank_multiprocess(tmp_path):
     """save_rank=1: the non-zero process writes the consolidated payload +
     metadata (reference DDPIO._save_rank, io_ops.py:551-623); barriers must
@@ -131,11 +133,13 @@ def test_save_rank_multiprocess(tmp_path):
     run_workers("save_rank", str(tmp_path))
 
 
+@pytest.mark.slow
 def test_sharded_save_multiprocess(tmp_path):
     """fsdp + orbax sharded save/load across 2 processes."""
     run_workers("sharded_save", str(tmp_path))
 
 
+@pytest.mark.slow
 def test_async_sharded_save_multiprocess(tmp_path):
     """Multi-host ASYNC sharded save (orbax AsyncCheckpointer): training
     continues during the background write, meta.json appears only after the
@@ -144,6 +148,7 @@ def test_async_sharded_save_multiprocess(tmp_path):
     run_workers("async_sharded_save", str(tmp_path))
 
 
+@pytest.mark.slow
 def test_composed_mesh_multiprocess(tmp_path):
     """Pod-style composed meshes across 2 processes × 4 devices: dp×tp
     train step (TP collectives cross the process boundary), dp×seq ring
@@ -152,6 +157,7 @@ def test_composed_mesh_multiprocess(tmp_path):
     run_workers("composed_mesh", str(tmp_path))
 
 
+@pytest.mark.slow
 def test_loader_sampler_enforcement_and_sharding(tmp_path):
     """Sampler required multi-process; shards are disjoint and cover all."""
     run_workers("loader", str(tmp_path))
@@ -161,5 +167,6 @@ def test_loader_sampler_enforcement_and_sharding(tmp_path):
     assert not (s0 & s1)
 
 
+@pytest.mark.slow
 def test_indivisible_batch_raises_multiprocess(tmp_path):
     run_workers("batch_divisible", str(tmp_path))

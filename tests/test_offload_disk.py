@@ -109,6 +109,7 @@ def test_store_protects_aliased_params(tmp_path):
     store.close()
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("grad_accum", [1, 2])
 def test_disk_offload_matches_device(devices, rng, tmp_path, grad_accum):
     """Training with the disk tier is numerically identical to without."""
@@ -129,6 +130,7 @@ def test_disk_offload_matches_device(devices, rng, tmp_path, grad_accum):
         np.testing.assert_allclose(np.asarray(pa), np.asarray(pb), rtol=0, atol=0)
 
 
+@pytest.mark.slow
 def test_disk_offload_single_device(rng, tmp_path):
     """The tier also works without a mesh (single-device runs)."""
     a = _make_stoke(None, disk=False)
@@ -145,6 +147,7 @@ def test_disk_offload_single_device(rng, tmp_path):
         np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
 
 
+@pytest.mark.slow
 def test_disk_offload_checkpoint_roundtrip(devices, rng, tmp_path):
     """save/load materializes the spilled state and re-spills on restore."""
     s = _make_stoke(devices, disk=True, tmp=tmp_path / "s")
@@ -162,6 +165,7 @@ def test_disk_offload_checkpoint_roundtrip(devices, rng, tmp_path):
     s.train_step(x, (y,))
 
 
+@pytest.mark.slow
 def test_disk_excludes_host_offload(devices):
     with pytest.raises(ValueError, match="mutually exclusive"):
         Stoke(

@@ -52,6 +52,7 @@ def test_pipeline_matches_sequential(rng, stage_mesh):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow
 def test_pipeline_grads_match_sequential(rng, stage_mesh):
     trees, stacked = make_params(rng)
     xs = jnp.asarray(rng.normal(size=(M, B, D)).astype(np.float32))
@@ -70,6 +71,7 @@ def test_pipeline_grads_match_sequential(rng, stage_mesh):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipelined_lm_trains_through_facade(rng, stage_mesh):
     """PipelinedLM: 4-stage pipeline-parallel causal LM training through the
     Stoke facade with stage-sharded parameters."""
@@ -162,6 +164,7 @@ def sequential_l(trees, xs):
 
 
 @pytest.mark.parametrize("rounds", [2, 3])
+@pytest.mark.slow
 def test_circular_matches_sequential(rng, stage_mesh, rounds):
     """rounds=V: L = V*S stages interleaved over S devices must equal the
     L-stage sequential run (Megatron-interleaved / praxis-circular
@@ -175,6 +178,7 @@ def test_circular_matches_sequential(rng, stage_mesh, rounds):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow
 def test_circular_grads_match_sequential(rng, stage_mesh):
     L = 2 * S
     trees, stacked = make_l_params(rng, L)
@@ -202,6 +206,7 @@ def test_circular_rejects_too_few_microbatches(rng, stage_mesh):
         piped(stacked, xs)
 
 
+@pytest.mark.slow
 def test_remat_matches(rng, stage_mesh):
     """remat=True (1F1B-style activation memory) is numerically identical."""
     trees, stacked = make_params(rng)
@@ -272,6 +277,7 @@ def test_pipeline_with_edges(rng, stage_mesh):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow
 def test_pipelined_lm_circular_trains(rng, stage_mesh):
     """PipelinedLM with rounds=2 (8 virtual stages on 4 devices) trains."""
     import optax
@@ -320,6 +326,7 @@ def test_bubble_accounting():
     assert circ_bubble < gpipe_bubble / 3
 
 
+@pytest.mark.slow
 def test_pipeline_divisible_M_reduce_scatter_emit(rng, stage_mesh):
     """M % S == 0 routes the output emit through psum_scatter: values and
     gradients still match sequential, and the lowered HLO carries a
@@ -373,6 +380,7 @@ def dp_pp_mesh(devices):
     )
 
 
+@pytest.mark.slow
 def test_dp_pp_composed_matches_sequential(rng, dp_pp_mesh):
     """dp x pp (VERDICT r4 item 5): the batch dim shards over 'data', the
     stage rotation stays within each data group; forward AND gradients must
@@ -413,6 +421,7 @@ def test_dp_pp_composed_matches_sequential(rng, dp_pp_mesh):
                                    atol=2e-5)
 
 
+@pytest.mark.slow
 def test_dp_pp_circular_composed(rng, dp_pp_mesh):
     """Circular schedule composes with the data axis identically."""
     trees, stacked = make_params(rng)
@@ -428,6 +437,7 @@ def test_dp_pp_circular_composed(rng, dp_pp_mesh):
                                atol=2e-6)
 
 
+@pytest.mark.slow
 def test_pipelined_lm_train_steps_dp_pp(rng, dp_pp_mesh):
     """PipelinedLM on a composed ("data","stage") mesh through the
     train_steps multi-step scan: the full dp x pp training integration

@@ -99,6 +99,7 @@ def _train(s, steps=4):
     return s
 
 
+@pytest.mark.slow
 def test_bert_tp_placement(devices):
     s = _make_bert_stoke(tp=True)
     flat = jax.tree_util.tree_flatten_with_path(s.params)[0]
@@ -114,6 +115,7 @@ def test_bert_tp_placement(devices):
     assert ffo and all(v == P("model", None) for v in ffo)
 
 
+@pytest.mark.slow
 def test_bert_tp_matches_dp(devices):
     """TP is placement-only: training numerics must equal pure DP."""
     s_dp = _train(_make_bert_stoke(tp=False))
@@ -126,6 +128,7 @@ def test_bert_tp_matches_dp(devices):
         )
 
 
+@pytest.mark.slow
 def test_tp_composes_with_fsdp(devices):
     """Rules override matching params; everything else follows the tier."""
     from stoke_tpu import FSDPConfig

@@ -104,6 +104,7 @@ def test_loss_reduction_sum(rng):
         s.detach_and_sync_loss(l, user_reduction="nope")
 
 
+@pytest.mark.slow
 def test_force_cpu_contract():
     """force_cpu works before backend init and raises after (subprocesses:
     this test process has backends initialized already)."""
@@ -151,6 +152,7 @@ def test_multihost_env_detection(monkeypatch):
     assert _multihost_env_present() is True
 
 
+@pytest.mark.slow
 def test_tb_writer_format_contract(tmp_path):
     """The native TB event writer produces byte-correct TensorBoard files:
     CRC-checked round-trip through our parser, and — when the real
