@@ -182,6 +182,55 @@ def main():
     print(json.dumps(rec), flush=True)
     del stoke, xs, ys
 
+    # 4b. ImageNet-shape ResNet-50 (224x224): the conv-utilization control.
+    # Same model family as the headline bench but with spatial extents that
+    # CAN tile the MXU — if ITS fraction-of-peak is healthy while the 32x32
+    # run's is not, the CIFAR gap is conv shape, not the conv path itself.
+    if not args.smoke:
+        b224 = 64
+        model224 = ResNet50(num_classes=1000, cifar_stem=False)
+        v224 = init_module(
+            model224, jax.random.PRNGKey(0),
+            np.zeros((2, 224, 224, 3), np.float32), train=False,
+        )
+        s224 = Stoke(
+            model=model224,
+            optimizer=StokeOptimizer(
+                optimizer=optax.sgd,
+                optimizer_kwargs={"learning_rate": 0.05, "momentum": 0.9},
+            ),
+            loss=lambda lo, la: (
+                optax.softmax_cross_entropy_with_integer_labels(lo, la).mean()
+            ),
+            params=v224,
+            batch_size_per_device=b224,
+            device="tpu" if jax.default_backend() != "cpu" else "cpu",
+            precision="bf16",
+            model_train_kwargs={"train": True},
+            model_eval_kwargs={"train": False},
+            verbose=False,
+        )
+        x224 = jax.device_put(
+            r.normal(size=(b224, 224, 224, 3)).astype(np.float32))
+        y224 = jax.device_put(r.integers(0, 1000, size=(b224,)))
+        f224 = s224.estimate_step_flops(x224, (y224,))
+        xs224 = jax.device_put(
+            r.normal(size=(2, b224, 224, 224, 3)).astype(np.float32))
+        ys224 = jax.device_put(r.integers(0, 1000, size=(2, b224)))
+        t224 = delta_time(lambda: s224.train_steps(xs224, (ys224,)), 3)
+        rec224 = {"probe": "resnet224", "batch": b224,
+                  "step_ms": round(t224 / 2 * 1e3, 2),
+                  "imgs_per_sec": round(b224 * 2 / t224, 1)}
+        if f224:
+            ach = f224 / (t224 / 2) / 1e12
+            rec224["achieved_tflops"] = round(ach, 2)
+            rec224["fraction_of_matmul_peak"] = round(ach / peak_tflops, 4)
+            _persist_mfu("imagenet_resnet50_224_bf16_train_mfu",
+                         rec224["fraction_of_matmul_peak"], rec224,
+                         peak_tflops)
+        print(json.dumps(rec224), flush=True)
+        del s224, xs224, ys224, x224, y224, v224, model224
+
     # 5. compute-dense ceiling: GPT with MXU-shaped matmuls (hidden-width
     # GEMMs at seq 1k).  If THIS hits a healthy fraction of the measured
     # matmul peak while the 32x32 ResNet does not, the ResNet gap is

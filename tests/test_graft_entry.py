@@ -25,5 +25,5 @@ def test_dryrun_multichip_subprocess():
     assert out.returncode == 0, out.stderr[-800:]
     assert "dryrun_multichip(8): OK" in out.stdout
     for part in ("dp+fsdp+bf16", "dp4×tp2", "ring-attention", "zigzag-ring",
-                 "chunked-CE", "dp2×pp4 pipeline"):
+                 "chunked-CE", "dp2×ep4 MoE", "dp2×pp4 pipeline"):
         assert part in out.stdout, f"missing {part} sub-check\n{out.stdout}"

@@ -42,4 +42,5 @@ def test_dryrun_composed_meshes_at_scale(n):
     sp = 4 if n >= 16 else 2
     assert f"×seq{sp} ring-attention fwd+bwd OK" in out
     assert f"×seq{sp} zigzag-ring fwd+bwd OK" in out
+    assert f"dp{n // 4}×ep4 MoE train step OK" in out
     assert f"dp{n // 4}×pp4 pipeline fwd+bwd OK" in out

@@ -136,3 +136,37 @@ def test_yaml_file_roundtrip(tmp_path):
     kw = stoke_kwargs_from_config(str(p))
     assert kw["batch_size_per_device"] == 4
     assert kw["configs"]
+
+
+def test_round4_fields_flow_through_yaml(devices, tmp_path):
+    """Round-4 parity fields (PrecisionConfig.num_losses, CheckpointConfig.
+    save_rank) flow from an actual YAML FILE like every other knob."""
+    cfg = {
+        "batch_size_per_device": 4,
+        "device": "cpu",
+        "precision": "fp16",
+        "optimizer": {"name": "sgd", "learning_rate": 0.1},
+        "configs": {
+            "PrecisionConfig": {"num_losses": 2, "init_scale": 256.0},
+            "CheckpointConfig": {"save_rank": 1},
+        },
+    }
+
+    def two_losses(o, y):
+        return (jnp.mean((o - y) ** 2), 0.01 * jnp.mean(o**2))
+
+    import yaml
+
+    p = tmp_path / "run.yaml"
+    p.write_text(yaml.safe_dump(cfg))
+    s = stoke_from_config(
+        model=linear, loss=two_losses, params={"w": jnp.zeros((4, 2))},
+        cfg=str(p), verbose=False,
+    )
+    assert s.scaler["scale"].shape == (2,)
+    assert s.loss_scale == [256.0, 256.0]
+    assert s._status_obj.checkpoint_config.save_rank == 1
+    x = np.zeros((4, 4), np.float32)
+    y = np.zeros((4, 2), np.float32)
+    s.train_step(x, y)
+    assert s.optimizer_steps == 1
