@@ -41,7 +41,8 @@ def load_digits_32():
     return (x[n_test:], y[n_test:]), (x[:n_test], y[:n_test])
 
 
-def build(model_name, num_classes, lr, steps_per_epoch, epochs):
+def build(model_name, num_classes, lr, steps_per_epoch, epochs,
+          precision="auto"):
     import jax
     import optax
 
@@ -58,6 +59,8 @@ def build(model_name, num_classes, lr, steps_per_epoch, epochs):
     )
     sched = optax.cosine_decay_schedule(lr, steps_per_epoch * epochs)
     on_accel = jax.default_backend() not in ("cpu",)
+    if precision == "auto":
+        precision = "bf16" if on_accel else None
     return Stoke(
         model=model,
         optimizer=StokeOptimizer(
@@ -70,7 +73,7 @@ def build(model_name, num_classes, lr, steps_per_epoch, epochs):
         params=variables,
         batch_size_per_device=128,
         device="tpu" if on_accel else "cpu",
-        precision="bf16" if on_accel else None,
+        precision=precision,
         model_train_kwargs={"train": True},
         model_eval_kwargs={"train": False},
         verbose=False,
@@ -91,11 +94,11 @@ def evaluate(stoke, x, y, batch=128):
     return correct / max(n, 1)
 
 
-def run_digits(model_name, epochs, augment=False):
+def run_digits(model_name, epochs, augment=False, precision="auto"):
     (xt, yt), (xv, yv) = load_digits_32()
     batch = 128
     spe = len(xt) // batch
-    stoke = build(model_name, 10, 0.02, spe, epochs)
+    stoke = build(model_name, 10, 0.02, spe, epochs, precision=precision)
     rng = np.random.default_rng(1)
 
     def shift_batch(xb):
@@ -123,6 +126,8 @@ def run_digits(model_name, epochs, augment=False):
     print(json.dumps({
         "phase": "digits_real_data", "model": model_name, "epochs": epochs,
         "augment": augment,
+        "precision": getattr(stoke.status["precision"], "name",
+                             str(stoke.status["precision"])),
         "train_n": len(xt), "test_n": len(xv),
         "top1": round(acc, 4), "wall_s": round(wall, 1),
         "ema_loss": round(float(stoke.ema_loss), 4),
@@ -171,8 +176,29 @@ if __name__ == "__main__":
     if not args._worker:
         from _supervise import supervise
 
-        sys.exit(supervise(__file__, sys.argv[1:]))
+        # budget covers the digits run, a possible precision-fallback
+        # retry of the same length, and the overfit phase
+        sys.exit(supervise(__file__, sys.argv[1:], watchdog_seconds=5400))
+    WATCHDOG = 5400
+    t_main = time.time()
     acc = run_digits(args.model, args.epochs, augment=args.augment)
+    first_wall = time.time() - t_main
+    import jax as _jx
+
+    precision_used = "bf16" if _jx.default_backend() != "cpu" else "full"
+    if (acc < 0.95 and _jx.default_backend() != "cpu"
+            and first_wall * 1.3 < WATCHDOG - (time.time() - t_main) - 300):
+        # bf16 missed the gate on-chip: retry once in f32 before declaring
+        # failure (the CPU rehearsal passed in f32; precision is our choice,
+        # the gate metric is accuracy) — keep the better result.  Skipped
+        # when the remaining watchdog budget cannot fit another run.
+        print(json.dumps({"phase": "precision_fallback",
+                          "bf16_top1": round(float(acc), 4)}), flush=True)
+        acc_f32 = run_digits(args.model, args.epochs,
+                             augment=args.augment, precision="full")
+        if acc_f32 > acc:
+            acc = acc_f32
+            precision_used = "full"
     ok = acc >= 0.95
     if not args.skip_overfit:
         oacc = run_synthetic_overfit(args.model)
@@ -192,15 +218,24 @@ if __name__ == "__main__":
         backend = _jax.default_backend()
         prev_rec = _bench._load_results().get(metric, {})
         prev = prev_rec.get("value", 0.0)
-        # backend-aware keep-best (ADVICE r3): records carry a structured
-        # `backend` field; an accelerator measurement always outranks a CPU
-        # rehearsal regardless of value, so a high CPU number can never mask
-        # or block the on-chip gate result consumers actually want
-        rank = (0 if backend == "cpu" else 1, float(acc))
+        # backend- and precision-aware keep-best (ADVICE r3 + review r4):
+        # an accelerator measurement always outranks a CPU rehearsal, and
+        # within on-chip results the bf16 policy (the headline config)
+        # outranks an f32 fallback regardless of value — an f32 pass can
+        # never mask a later genuine bf16 pass
+        def _prec_rank(p):
+            return 1 if p == "bf16" else 0
+
+        rank = (0 if backend == "cpu" else 1,
+                _prec_rank(precision_used), float(acc))
         prev_rank = (
             0 if _bench.record_backend(prev_rec) == "cpu" else 1,
+            # legacy on-chip records predate the field and were bf16 runs
+            _prec_rank(prev_rec.get("precision",
+                                    "bf16" if _bench.record_backend(prev_rec)
+                                    != "cpu" else "full")),
             float(prev),
-        ) if prev_rec else (-1, 0.0)
+        ) if prev_rec else (-1, -1, 0.0)
         if acc >= 0.95 and rank > prev_rank:
             _bench.persist_result(
                 metric,
@@ -213,6 +248,7 @@ if __name__ == "__main__":
                     + ("/augment" if args.augment else ""),
                     "batch": 128,
                     "backend": backend,
+                    "precision": precision_used,
                     "source": f"scripts/accuracy_run.py on {backend}",
                     "note": "cpu f32 rehearsal (same facade/engine path; "
                     "on-chip bf16 re-run pending)"
