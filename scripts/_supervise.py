@@ -6,17 +6,38 @@ imports jax itself: it pre-probes the device in a timeboxed subprocess, then
 runs the real measurement (``<script> --_worker ...``) under a watchdog, so
 callers always get an error line instead of a hang (BENCH_NOTES.md "Tunnel
 discipline").
+
+Two watchdogs (review r4: a total-wall-clock kill rations healthy-but-slow
+sessions, and killing an in-flight TPU client mid-stream is itself a wedge
+trigger — so kill only on evidence of a hang):
+
+- ``idle_seconds``: no worker stdout for this long means a hang (every
+  measurement phase prints a JSON line when it completes); this is the
+  primary kill.
+- ``watchdog_seconds``: absolute backstop.
+
+The worker's environment carries ``STOKE_SESSION_DEADLINE`` (epoch seconds
+of the absolute backstop) so long-running workers can budget optional extra
+phases (e.g. accuracy_run's f32 retry) against the REAL remaining time,
+including when they run inside tpu_session's umbrella.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import selectors
 import subprocess
 import sys
+import time
 
 
-def supervise(script_file: str, argv, watchdog_seconds: int = 2400) -> int:
+def supervise(
+    script_file: str,
+    argv,
+    watchdog_seconds: int = 2400,
+    idle_seconds: int | None = None,
+) -> int:
     try:
         probe = subprocess.run(
             [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
@@ -29,12 +50,45 @@ def supervise(script_file: str, argv, watchdog_seconds: int = 2400) -> int:
     except (subprocess.TimeoutExpired, RuntimeError) as e:
         print(json.dumps({"error": f"device probe failed: {e}"[:250]}))
         return 1
+    deadline = time.time() + watchdog_seconds
+    env = {**os.environ, "STOKE_SESSION_DEADLINE": repr(deadline)}
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(script_file), "--_worker", *argv],
+        text=True,
+        stdout=subprocess.PIPE,
+        env=env,
+        bufsize=1,
+    )
+    sel = selectors.DefaultSelector()
+    sel.register(proc.stdout, selectors.EVENT_READ)
+    last_output = time.time()
+    why = None
     try:
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(script_file), "--_worker", *argv],
-            text=True, timeout=watchdog_seconds,
-        )
-        return out.returncode
-    except subprocess.TimeoutExpired:
-        print(json.dumps({"error": f"timed out after {watchdog_seconds}s"}))
-        return 1
+        while True:
+            for _ in sel.select(timeout=5):
+                line = proc.stdout.readline()
+                if line:
+                    print(line, end="", flush=True)
+                    last_output = time.time()
+            if proc.poll() is not None:
+                rest = proc.stdout.read()
+                if rest:
+                    print(rest, end="", flush=True)
+                return proc.returncode
+            now = time.time()
+            if now > deadline:
+                why = f"timed out after {watchdog_seconds}s (absolute backstop)"
+                break
+            if idle_seconds and now - last_output > idle_seconds:
+                why = (
+                    f"no output for {idle_seconds}s (worker hung; killing is "
+                    f"a known relay-wedge risk but the alternative is hanging "
+                    f"forever)"
+                )
+                break
+    finally:
+        sel.close()
+    proc.kill()
+    proc.wait()
+    print(json.dumps({"error": why}))
+    return 1

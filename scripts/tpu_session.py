@@ -49,8 +49,13 @@ QUEUE = {
     # queue): tiny preset, finishes in ~1 min off-chip
     "smoke": ("bench.py", ["--preset", "tiny"]),
 }
-DEFAULT_QUEUE = ("flops_probe", "accuracy", "longcontext", "op_ring",
-                 "chunked_ce", "bench", "profile")
+# importance order: if the tunnel dies (or the watchdog fires) mid-session,
+# everything already run has persisted — so the official bench headline
+# comes FIRST, then the never-measured MFU numbers, the accuracy gate, the
+# profiler evidence, and the long-context arms last (they have round-2
+# hardware numbers already)
+DEFAULT_QUEUE = ("bench", "flops_probe", "accuracy", "profile",
+                 "longcontext", "op_ring", "chunked_ce")
 
 
 def main():
@@ -69,7 +74,13 @@ def main():
         except OSError:
             lock = None
         try:
-            sys.exit(supervise(__file__, sys.argv[1:], watchdog_seconds=5400))
+            # hang detection is idle-based (every queue item prints a JSON
+            # line per phase; 1h of silence on a chip means a hang, not a
+            # slow phase); the 6h absolute cap is a backstop only — a
+            # healthy-but-slow 7-item session must never be rationed into
+            # a mid-stream kill (itself a relay-wedge trigger)
+            sys.exit(supervise(__file__, sys.argv[1:],
+                               watchdog_seconds=21600, idle_seconds=3600))
         finally:
             if lock:
                 try:

@@ -329,6 +329,8 @@ def zigzag_permutation(L: int, size: int):
 
 
 def inverse_permutation(perm):
+    """Inverse of an index permutation: ``x[perm][inverse_permutation(perm)]
+    == x`` (used to undo the zigzag sequence layout host-side)."""
     inv = np.empty_like(perm)
     inv[perm] = np.arange(len(perm))
     return inv
