@@ -130,6 +130,7 @@ def _emit_persisted(metric: str, capture_error: str,
             "measured_by": rec.get("source", "bench.py"),
             "api": rec.get("api"),
             "batch": rec.get("batch"),
+            "steps_per_dispatch": rec.get("steps_per_dispatch"),
             "capture_error": capture_error,
             "note": "persisted last verified on-chip measurement "
             "(fresh capture failed; see capture_error and BENCH_NOTES.md)",
@@ -259,12 +260,24 @@ def main():
                     help="facade path to measure; train_steps (multi-step "
                     "scan, one dispatch per N optimizer steps) is the "
                     "fastest measured (scripts/bench_sweep.py)")
+    ap.add_argument("--seg", type=int, default=10,
+                    help="optimizer steps per train_steps dispatch — the "
+                    "per-step share of dispatch/relay round-trip latency "
+                    "is RTT/seg (see profile_capture.py seg_sweep)")
     ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
     if not args._worker:
         sys.exit(_supervise(
             sys.argv[1:], args.preset,
-            requested={"api": args.api, "batch": args.batch},
+            requested={
+                "api": args.api,
+                "batch": args.batch,
+                # a record measured at a different scan-segment length is a
+                # different configuration — never substituted for this run
+                "steps_per_dispatch": (
+                    max(1, args.seg) if args.api == "train_steps" else None
+                ),
+            },
         ))
 
     import numpy as np
@@ -315,7 +328,7 @@ def main():
     per_call = 1
     if api == "train_steps":
         # multi-step scan: SEG optimizer steps per compiled dispatch
-        SEG = 10
+        SEG = max(1, args.seg)
         xs = jax.device_put(r.normal(size=(SEG, batch, 32, 32, 3)).astype(np.float32))
         ys = jax.device_put(r.integers(0, 10, size=(SEG, batch)))
         per_call = SEG
@@ -378,7 +391,10 @@ def main():
     # persist here too (not only in the supervisor): inside
     # scripts/tpu_session.py the worker runs directly, with no supervisor
     # to parse and record the line.  Idempotent with the supervisor's write.
-    if on_accel and result["value"] > 0:
+    # Keep-best: a slower configuration (e.g. a seg-sweep arm) must never
+    # clobber a faster verified record of the same metric.
+    prev_best = _load_results().get(result["metric"], {}).get("value", 0.0)
+    if on_accel and result["value"] > prev_best:
         _persist_result(
             result["metric"],
             {

@@ -108,9 +108,8 @@ def main():
     artifacts = os.path.join(REPO, "artifacts")
     os.makedirs(artifacts, exist_ok=True)
 
-    results = []
-    for batch in batches:
-        stoke = Stoke(
+    def make_stoke(batch):
+        return Stoke(
             model=model,
             optimizer=StokeOptimizer(
                 optimizer=optax.sgd,
@@ -130,6 +129,10 @@ def main():
             configs=[ProfilerConfig(wall_clock_breakdown=True)],
             verbose=False,
         )
+
+    results = []
+    for batch in batches:
+        stoke = make_stoke(batch)
         xs = jax.device_put(
             r.normal(size=(SEG, batch, 32, 32, 3)).astype(np.float32))
         ys = jax.device_put(r.integers(0, 10, size=(SEG, batch)))
@@ -203,6 +206,34 @@ def main():
             "falls_with_batch": results[-1]["imgs_per_sec"]
             < results[0]["imgs_per_sec"],
         }), flush=True)
+
+    # segment-length sweep at the headline batch: each train_steps dispatch
+    # is one host->device round trip; through the remote relay the
+    # per-step share of that latency is RTT/SEG, so if throughput rises
+    # with SEG the gap is dispatch latency (recoverable by config), not
+    # compute.  delta_time cancels FIXED overhead but not per-dispatch
+    # cost.  Runs AFTER the summary, each arm fenced, so a seg-arm failure
+    # (OOM on the 50-step stack, tunnel hiccup) cannot lose the evidence
+    # the batch sweep already paid tunnel time for.
+    seg_batch = 256 if 256 in batches else batches[0]
+    for seg in (10, 25, 50):
+        if args.smoke and seg > 10:
+            break
+        try:
+            stoke = make_stoke(seg_batch)
+            xs = jax.device_put(
+                r.normal(size=(seg, seg_batch, 32, 32, 3)).astype(np.float32))
+            ys = jax.device_put(r.integers(0, 10, size=(seg, seg_batch)))
+            t = delta_time(lambda: stoke.train_steps(xs, (ys,)), 3)
+            print(json.dumps({
+                "probe": "seg_sweep", "batch": seg_batch, "seg": seg,
+                "step_ms": round(t / seg * 1e3, 3),
+                "imgs_per_sec": round(seg_batch * seg / t, 1),
+            }), flush=True)
+            del stoke, xs, ys
+        except Exception as e:
+            print(json.dumps({"probe": "seg_sweep", "seg": seg,
+                              "error": str(e)[:200]}), flush=True)
 
 
 if __name__ == "__main__":

@@ -41,6 +41,10 @@ QUEUE = {
                    ["--chunked-ce", "--vocab", "32768",
                     "--lengths", "4096,8192", "--batch", "2"]),
     "bench": ("bench.py", []),
+    # seg-50 arm: if the relay's per-dispatch round trip is a real cost,
+    # a longer scan segment amortizes it 5x; bench persistence is
+    # keep-best so whichever configuration is faster owns the headline
+    "bench_seg50": ("bench.py", ["--seg", "50"]),
     # evidence capture for the 0.46x ResNet attack (VERDICT r3 item 2):
     # batch sweep + HLO op histogram + wall-clock breakdown
     "profile": ("scripts/profile_capture.py",
@@ -55,7 +59,7 @@ QUEUE = {
 # profiler evidence, and the long-context arms last (they have round-2
 # hardware numbers already)
 DEFAULT_QUEUE = ("bench", "flops_probe", "accuracy", "profile",
-                 "longcontext", "op_ring", "chunked_ce")
+                 "bench_seg50", "longcontext", "op_ring", "chunked_ce")
 
 
 def main():
