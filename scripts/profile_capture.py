@@ -219,6 +219,18 @@ def main():
     for seg in (10, 25, 50):
         if args.smoke and seg > 10:
             break
+        if seg == SEG:
+            # the batch sweep already measured this exact configuration —
+            # reuse it instead of paying tunnel time for a duplicate point
+            prior = next(r for r in results if r["batch"] == seg_batch)
+            print(json.dumps({
+                "probe": "seg_sweep", "batch": seg_batch, "seg": seg,
+                "step_ms": prior["step_ms"],
+                "imgs_per_sec": prior["imgs_per_sec"],
+                "reused_from_batch_sweep": True,
+            }), flush=True)
+            continue
+        stoke = xs = ys = None
         try:
             stoke = make_stoke(seg_batch)
             xs = jax.device_put(
@@ -230,10 +242,14 @@ def main():
                 "step_ms": round(t / seg * 1e3, 3),
                 "imgs_per_sec": round(seg_batch * seg / t, 1),
             }), flush=True)
-            del stoke, xs, ys
         except Exception as e:
             print(json.dumps({"probe": "seg_sweep", "seg": seg,
                               "error": str(e)[:200]}), flush=True)
+        finally:
+            # release THIS arm's HBM before the next (larger) arm allocates
+            # — a failed seg-25 stack left referenced would cascade the
+            # anticipated OOM into the seg-50 point
+            del stoke, xs, ys
 
 
 if __name__ == "__main__":

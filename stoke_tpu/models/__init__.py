@@ -16,6 +16,13 @@ from stoke_tpu.models.bert import (
     dense_attention,
 )
 from stoke_tpu.models.gpt import GPT, GPTBase, GPTTiny, causal_lm_loss
+
+# The whole transformer family (BERT / GPT / ViT) shares TransformerBlock's
+# parameter paths (attention/{qkv,out}, ff_{in,out}), so the Megatron-style
+# column/row-parallel rules apply to every member; the aliases make intent
+# explicit at call sites.
+gpt_tensor_parallel_rules = bert_tensor_parallel_rules
+vit_tensor_parallel_rules = bert_tensor_parallel_rules
 from stoke_tpu.models.moe import (
     MoEFFN,
     MoETransformerBlock,
@@ -40,6 +47,8 @@ __all__ = [
     "BertForSequenceClassification",
     "BertTiny",
     "bert_tensor_parallel_rules",
+    "gpt_tensor_parallel_rules",
+    "vit_tensor_parallel_rules",
     "dense_attention",
     "GPT",
     "GPTBase",

@@ -176,3 +176,53 @@ def test_tp_composes_with_fsdp(devices):
     assert emb and all("data" in str(v) for v in emb)
     _train(s, steps=2)
     assert s.optimizer_steps == 2
+
+
+@pytest.mark.slow
+def test_gpt_tp_matches_dp(devices):
+    """The TP rules are family-wide: GPT shares TransformerBlock's param
+    paths, so gpt_tensor_parallel_rules (= the bert rules) must place its
+    qkv/ff weights on the model axis and train with numerics equal to DP."""
+    from stoke_tpu.models import GPT, causal_lm_loss, gpt_tensor_parallel_rules
+
+    def make(tp):
+        model = GPT(vocab_size=64, size_name="tiny", max_len=32,
+                    dropout_rate=0.0)
+        seq = np.tile(np.arange(16, dtype=np.int32), 2)[None, :].repeat(8, 0)
+        v = init_module(model, jax.random.PRNGKey(0), seq[:2], train=False)
+        cfgs = [MeshConfig(axes=("data", "model"), shape=(4, 2))]
+        if tp:
+            cfgs.append(
+                PartitionRulesConfig(rules=gpt_tensor_parallel_rules())
+            )
+        s = Stoke(
+            model=model,
+            # SGD, same reasoning as _make_bert_stoke: adam's sqrt
+            # normalization turns TP reassociation noise on near-zero
+            # gradients (e.g. the symmetric-init qkv bias) into O(lr) flips
+            optimizer=StokeOptimizer(
+                optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.05}
+            ),
+            loss=causal_lm_loss,
+            params=v,
+            batch_size_per_device=1,
+            device="cpu",
+            distributed="dp",
+            configs=cfgs,
+            verbose=False,
+        )
+        for _ in range(5):
+            s.train_step(seq, (seq,))
+        return s
+
+    s_tp = make(tp=True)
+    w = s_tp.params["layer_0"]["attention"]["qkv"]["kernel"]
+    assert "model" in jax.tree_util.tree_leaves(
+        [w.sharding.spec]
+    )[0] or "model" in tuple(w.sharding.spec), w.sharding.spec
+    s_dp = make(tp=False)
+    for x, y in zip(jax.tree_util.tree_leaves(s_dp.params),
+                    jax.tree_util.tree_leaves(s_tp.params)):
+        np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=5e-4, atol=5e-6
+        )
