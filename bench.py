@@ -152,6 +152,30 @@ def _emit_persisted(metric: str, capture_error: str,
     return 1
 
 
+#: a fresh capture this far below the ledger best is flagged as a regression
+REGRESSION_TOLERANCE = 0.05
+
+
+def check_regression(metric: str, value: float) -> dict | None:
+    """Compare a FRESH capture against the ledger best for ``metric``.
+
+    Returns a regression descriptor when ``value`` is more than
+    ``REGRESSION_TOLERANCE`` below the best verified record (so a slower
+    round surfaces the round it happens — VERDICT r4 item 8), else None.
+    Records measured under a different api/batch are still comparable: the
+    ledger best IS the headline the metric is judged by.
+    """
+    best = _load_results().get(metric, {}).get("value", 0.0)
+    if best > 0 and value < best * (1.0 - REGRESSION_TOLERANCE):
+        return {
+            "best": best,
+            "ratio": round(value / best, 4),
+            "note": f"fresh capture regressed >{REGRESSION_TOLERANCE:.0%} "
+            f"below the ledger best ({value} vs {best})",
+        }
+    return None
+
+
 #: sentinel: probe succeeded but only the CPU backend is visible
 _CPU_ONLY = "cpu-only"
 
@@ -395,6 +419,18 @@ def main():
         "fresh": True,
         "measured_on": time.strftime("%Y-%m-%d"),
     }
+    if on_accel:
+        regression = check_regression(result["metric"], result["value"])
+        if regression is not None:
+            # loud, structured, and on both streams: the JSON line carries
+            # the flag for the driver, stderr for a human scanning logs
+            result["regression"] = regression
+            print(
+                f"bench.py REGRESSION: {result['metric']} fresh "
+                f"{result['value']} is {regression['ratio']:.2%} of ledger "
+                f"best {regression['best']}",
+                file=sys.stderr,
+            )
     print(json.dumps(result))
     # persist here too (not only in the supervisor): inside
     # scripts/tpu_session.py the worker runs directly, with no supervisor

@@ -24,6 +24,7 @@ including when they run inside tpu_session's umbrella.
 
 from __future__ import annotations
 
+import codecs
 import json
 import os
 import selectors
@@ -54,26 +55,46 @@ def supervise(
     env = {**os.environ, "STOKE_SESSION_DEADLINE": repr(deadline)}
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(script_file), "--_worker", *argv],
-        text=True,
         stdout=subprocess.PIPE,
         env=env,
-        bufsize=1,
     )
+    # Non-blocking relay (ADVICE r4): a blocking readline() after select()
+    # stalls until a full line arrives, so a worker wedging after a PARTIAL
+    # line would disable both watchdogs.  os.read() on a non-blocking fd
+    # always returns control to the watchdog loop.
+    fd = proc.stdout.fileno()
+    os.set_blocking(fd, False)
     sel = selectors.DefaultSelector()
     sel.register(proc.stdout, selectors.EVENT_READ)
     last_output = time.time()
     why = None
+    # incremental decoder: a multi-byte UTF-8 char straddling a 64 KiB read
+    # boundary must not decode to replacement chars mid-line
+    decoder = codecs.getincrementaldecoder("utf-8")(errors="replace")
+
+    def _relay() -> None:
+        nonlocal last_output
+        while True:
+            try:
+                chunk = os.read(fd, 65536)
+            except BlockingIOError:
+                return
+            except OSError:
+                return
+            if not chunk:
+                sys.stdout.write(decoder.decode(b"", final=True))
+                sys.stdout.flush()
+                return  # EOF
+            sys.stdout.write(decoder.decode(chunk))
+            sys.stdout.flush()
+            last_output = time.time()
+
     try:
         while True:
-            for _ in sel.select(timeout=5):
-                line = proc.stdout.readline()
-                if line:
-                    print(line, end="", flush=True)
-                    last_output = time.time()
+            if sel.select(timeout=5):
+                _relay()
             if proc.poll() is not None:
-                rest = proc.stdout.read()
-                if rest:
-                    print(rest, end="", flush=True)
+                _relay()
                 return proc.returncode
             now = time.time()
             if now > deadline:

@@ -254,10 +254,15 @@ if __name__ == "__main__":
                     "backend": backend,
                     "precision": precision_used,
                     "source": f"scripts/accuracy_run.py on {backend}",
-                    "note": "cpu f32 rehearsal (same facade/engine path; "
-                    "on-chip bf16 re-run pending)"
-                    if backend == "cpu"
-                    else "on-chip measurement",
+                    # derive provenance from the ACTUAL backend/precision
+                    # (ADVICE r4: free text must agree with the structured
+                    # fields — record_backend falls back on it)
+                    "note": (
+                        f"cpu {precision_used} rehearsal (same facade/engine "
+                        f"path; on-chip re-run pending)"
+                        if backend == "cpu"
+                        else f"on-chip {precision_used} measurement"
+                    ),
                 },
             )
     except Exception as e:  # ledger write must never fail the gate run

@@ -25,10 +25,14 @@ from __future__ import annotations
 
 import itertools
 import math
+import warnings
 from collections import deque
 from typing import Any, Callable, Iterator, List, Optional, Sequence
 
 import numpy as np
+
+#: one-time flag for the threaded-fallback semantics warning (ADVICE r4)
+_WARNED_THREADED = False
 
 
 # --------------------------------------------------------------------------- #
@@ -262,6 +266,20 @@ class _FallbackLoader:
             for idx in self._batch_indices():
                 yield self._assemble(idx)
             return
+        # num_workers > 0 without torch: __getitem__ now runs CONCURRENTLY
+        # on the one shared dataset object (torch would fork per-worker
+        # copies).  Surface the semantic change once so a dataset with
+        # shared mutable state (e.g. a seeked file handle) isn't silently
+        # raced (ADVICE r4).
+        global _WARNED_THREADED
+        if not _WARNED_THREADED:
+            _WARNED_THREADED = True
+            warnings.warn(
+                "torch-free fallback loader: num_workers>0 uses a THREAD "
+                "pool over the shared dataset object; __getitem__ must be "
+                "thread-safe (pass num_workers=0 for the sequential path)",
+                stacklevel=2,
+            )
         from concurrent.futures import ThreadPoolExecutor
 
         window = self.num_workers * self.prefetch_factor

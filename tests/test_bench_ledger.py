@@ -83,3 +83,24 @@ def test_emit_persisted_refuses_config_mismatch(ledger, capsys):
 def test_emit_persisted_no_record(ledger, capsys):
     rc, out = _emit(capsys, "never_measured")
     assert rc == 1 and out["value"] == 0.0
+
+
+def test_check_regression_flags_big_drop(ledger):
+    bench.persist_result("m", {"value": 9257.0, "backend": "tpu"})
+    reg = bench.check_regression("m", 8000.0)
+    assert reg is not None
+    assert reg["best"] == 9257.0
+    assert reg["ratio"] == round(8000.0 / 9257.0, 4)
+
+
+def test_check_regression_tolerates_noise_and_improvement(ledger):
+    bench.persist_result("m", {"value": 9257.0, "backend": "tpu"})
+    # within the 5% tolerance band: not a regression
+    assert bench.check_regression("m", 9257.0 * 0.96) is None
+    # faster than best: not a regression
+    assert bench.check_regression("m", 10000.0) is None
+
+
+def test_check_regression_no_prior_record(ledger):
+    # a first-ever measurement can never regress
+    assert bench.check_regression("never_measured", 1.0) is None

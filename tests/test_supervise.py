@@ -1,0 +1,47 @@
+"""Tests for scripts/_supervise.py — the tunnel-supervisor watchdogs.
+
+ADVICE r4: a worker that wedges after writing a PARTIAL line (no trailing
+newline) must still trip the idle watchdog; a blocking readline() after
+select() would stall the supervisor inside the read and disable both
+watchdogs.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(
+    __file__.rsplit("/tests/", 1)[0], "scripts"))
+from _supervise import supervise  # noqa: E402
+
+
+def test_idle_watchdog_fires_on_partial_line_hang(tmp_path, capsys):
+    worker = tmp_path / "wedge.py"
+    worker.write_text(
+        "import sys, time\n"
+        "sys.stdout.write('partial-no-newline')\n"
+        "sys.stdout.flush()\n"
+        "time.sleep(300)\n"
+    )
+    t0 = time.time()
+    rc = supervise(str(worker), [], watchdog_seconds=240, idle_seconds=5)
+    elapsed = time.time() - t0
+    assert rc == 1
+    # the idle watchdog (5s), not the absolute backstop (240s), fired
+    assert elapsed < 120, elapsed
+    out = capsys.readouterr().out
+    assert "partial-no-newline" in out
+    assert "no output for 5s" in out
+
+
+def test_supervise_relays_output_and_exit_code(tmp_path, capsys):
+    worker = tmp_path / "ok.py"
+    worker.write_text(
+        "import json\n"
+        "print(json.dumps({'phase': 'done'}))\n"
+    )
+    rc = supervise(str(worker), [], watchdog_seconds=120, idle_seconds=60)
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert json.loads(out.strip().splitlines()[-1]) == {"phase": "done"}
