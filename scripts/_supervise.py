@@ -68,30 +68,39 @@ def supervise(
     sel.register(proc.stdout, selectors.EVENT_READ)
     last_output = time.time()
     why = None
+    eof = False
     # incremental decoder: a multi-byte UTF-8 char straddling a 64 KiB read
     # boundary must not decode to replacement chars mid-line
     decoder = codecs.getincrementaldecoder("utf-8")(errors="replace")
 
     def _relay() -> None:
-        nonlocal last_output
-        while True:
+        nonlocal last_output, eof
+        while not eof:
             try:
                 chunk = os.read(fd, 65536)
             except BlockingIOError:
                 return
             except OSError:
+                eof = True
                 return
             if not chunk:
+                # EOF with the worker possibly still alive (stdout closed/
+                # redirected): unregister, or select() reports the dead fd
+                # ready forever and this loop busy-spins until a watchdog
+                eof = True
+                sel.unregister(proc.stdout)
                 sys.stdout.write(decoder.decode(b"", final=True))
                 sys.stdout.flush()
-                return  # EOF
+                return
             sys.stdout.write(decoder.decode(chunk))
             sys.stdout.flush()
             last_output = time.time()
 
     try:
         while True:
-            if sel.select(timeout=5):
+            if eof:
+                time.sleep(5)
+            elif sel.select(timeout=5):
                 _relay()
             if proc.poll() is not None:
                 _relay()

@@ -45,9 +45,6 @@ def _persist_mfu(metric: str, mfu, rec: dict, peak_tflops: float) -> None:
             return
         import bench
 
-        prev = bench._load_results().get(metric, {}).get("value", 0.0)
-        if mfu <= prev:
-            return
         bench.persist_result(
             metric,
             {
@@ -63,6 +60,7 @@ def _persist_mfu(metric: str, mfu, rec: dict, peak_tflops: float) -> None:
                 "step_ms": rec.get("step_ms"),
                 "source": "scripts/flops_probe.py fresh on-chip capture",
             },
+            keep_best=True,
         )
     except Exception as e:  # ledger write must never fail the probe
         print(json.dumps({"ledger_error": str(e)[:120]}), flush=True)

@@ -11,7 +11,7 @@
 #   (or call jax.distributed.initialize yourself — before any other JAX API).
 #
 # Example (the in-repo worker used by tests/test_multiprocess.py):
-#   scripts/launch_local.sh -n 2 -d 4 python tests/_mp_worker.py train_equivalence /tmp/out
+#   scripts/launch_local.sh -n 2 -d 4 python tests/_mp_worker.py train_equiv /tmp/out
 set -euo pipefail
 
 NPROC=2

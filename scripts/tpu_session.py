@@ -40,6 +40,15 @@ QUEUE = {
     "chunked_ce": ("scripts/bench_longcontext.py",
                    ["--chunked-ce", "--vocab", "32768",
                     "--lengths", "4096,8192", "--batch", "2"]),
+    # first hardware evidence for BASELINE config #5 (VERDICT r4 item 5):
+    # bucketed sampler + grad-accum + clip, short measured run -> ledger
+    "bert": ("scripts/onchip_probes.py", ["--only", "bert"]),
+    # dynamic fp16 scaler overflow->backoff->regrowth observed on hardware
+    # (VERDICT r4 item 6)
+    "fp16_scaler": ("scripts/onchip_probes.py", ["--only", "fp16_scaler"]),
+    # real-Mosaic kernel tests: flash fwd+bwd + ring+flash + zigzag +
+    # chunked-CE on silicon (VERDICT r4 item 3)
+    "flash_tests": ("scripts/onchip_probes.py", ["--only", "flash_tests"]),
     "bench": ("bench.py", []),
     # seg-50 arm: if the relay's per-dispatch round trip is a real cost,
     # a longer scan segment amortizes it 5x; bench persistence is
@@ -58,8 +67,9 @@ QUEUE = {
 # comes FIRST, then the never-measured MFU numbers, the accuracy gate, the
 # profiler evidence, and the long-context arms last (they have round-2
 # hardware numbers already)
-DEFAULT_QUEUE = ("bench", "flops_probe", "accuracy", "profile",
-                 "bench_seg50", "longcontext", "op_ring", "chunked_ce")
+DEFAULT_QUEUE = ("bench", "flops_probe", "accuracy", "flash_tests",
+                 "bert", "fp16_scaler", "profile", "bench_seg50",
+                 "longcontext", "op_ring", "chunked_ce")
 
 
 def main():

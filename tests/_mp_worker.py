@@ -8,7 +8,11 @@ coverage the reference's IO layer is built around (reference
 io_ops.py:551-703: barrier → gather/consolidate → rank-0 write → barrier)
 and that single-process tests cannot reach.
 
-Usage: _mp_worker.py <scenario> <process_id> <num_processes> <port> <tmpdir>
+Usage (explicit argv, as the pytest harness launches it):
+    _mp_worker.py <scenario> <process_id> <num_processes> <port> <tmpdir>
+Usage (under scripts/launch_local.sh, which exports STOKE_PROCESS_ID /
+STOKE_NUM_PROCESSES / JAX_COORDINATOR_ADDRESS per process):
+    scripts/launch_local.sh -n 2 -d 4 python tests/_mp_worker.py <scenario> <tmpdir>
 Prints ``WORKER_OK <scenario> <process_id>`` on success; any exception
 exits non-zero (the pytest side asserts both).
 """
@@ -17,13 +21,21 @@ import json
 import os
 import sys
 
-SCENARIO, PID, NPROC, PORT, TMP = (
-    sys.argv[1],
-    int(sys.argv[2]),
-    int(sys.argv[3]),
-    sys.argv[4],
-    sys.argv[5],
-)
+if len(sys.argv) >= 6:
+    SCENARIO, PID, NPROC, PORT, TMP = (
+        sys.argv[1],
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        sys.argv[4],
+        sys.argv[5],
+    )
+else:
+    SCENARIO = sys.argv[1]
+    TMP = sys.argv[2]
+    PID = int(os.environ["STOKE_PROCESS_ID"])
+    NPROC = int(os.environ["STOKE_NUM_PROCESSES"])
+    PORT = os.environ["JAX_COORDINATOR_ADDRESS"].rsplit(":", 1)[1]
+    os.makedirs(TMP, exist_ok=True)
 
 import jax  # noqa: E402  (env set by the launcher BEFORE interpreter start)
 

@@ -104,3 +104,19 @@ def test_check_regression_tolerates_noise_and_improvement(ledger):
 def test_check_regression_no_prior_record(ledger):
     # a first-ever measurement can never regress
     assert bench.check_regression("never_measured", 1.0) is None
+
+
+def test_persist_result_keep_best(ledger):
+    bench.persist_result("m", {"value": 9000.0, "backend": "tpu"})
+    # slower result with keep_best never clobbers the faster record
+    bench.persist_result("m", {"value": 100.0, "backend": "tpu"},
+                         keep_best=True)
+    assert bench._load_results()["m"]["value"] == 9000.0
+    # faster result replaces it
+    bench.persist_result("m", {"value": 9500.0, "backend": "tpu"},
+                         keep_best=True)
+    assert bench._load_results()["m"]["value"] == 9500.0
+    # without keep_best the write is unconditional (ranked callers like
+    # accuracy_run order by backend/precision, not value alone)
+    bench.persist_result("m", {"value": 42.0, "backend": "tpu"})
+    assert bench._load_results()["m"]["value"] == 42.0

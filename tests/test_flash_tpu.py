@@ -60,3 +60,119 @@ def test_flash_matches_dense_on_tpu(causal, masked):
         for a, b in zip(gf, gd)
     )
     assert gerr < BWD_RTOL_BF16 * max(gscale, 1.0)
+
+
+# ---- kernels added since round 2: first on-silicon validation ------------- #
+# (CPU-interpret equivalence is necessary, not sufficient: block-spec/VMEM
+# behavior differs on real Mosaic — VERDICT r4 item 3.)  On one chip the
+# ring degenerates to a single hop; the composition under test is the
+# per-hop flash call + lse merge wiring, which is exactly what changed.
+
+
+def _mesh_1chip():
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1), ("data", "seq"))
+
+
+def _qkv(r, B=2, H=4, L=512, D=64):
+    mk = lambda: jnp.asarray(
+        r.normal(size=(B, H, L, D)).astype(np.float32), jnp.bfloat16
+    )
+    return mk(), mk(), mk()
+
+
+def _grad_close(loss_a, loss_b, args_, rtol):
+    ga = jax.grad(loss_a, argnums=tuple(range(len(args_))))(*args_)
+    gb = jax.grad(loss_b, argnums=tuple(range(len(args_))))(*args_)
+    gscale = max(float(jnp.max(jnp.abs(b.astype(jnp.float32)))) for b in gb)
+    gerr = max(
+        float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+        for a, b in zip(ga, gb)
+    )
+    assert gerr < rtol * max(gscale, 1.0), (gerr, gscale)
+
+
+def test_ring_flash_inner_matches_dense_on_tpu():
+    from stoke_tpu.ops import ring_attention
+    from stoke_tpu.ops.flash_attention import (
+        BWD_RTOL_BF16,
+        FWD_ATOL_BF16,
+        dense_reference,
+    )
+
+    mesh = _mesh_1chip()
+    q, k, v = _qkv(np.random.default_rng(1))
+
+    def ring(q, k, v):
+        return ring_attention(
+            q, k, v, mesh=mesh, axis_name="seq", causal=True, inner="flash"
+        )
+
+    out = ring(q, k, v)
+    ref = dense_reference(q, k, v, None, causal=True)
+    assert float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref))) < FWD_ATOL_BF16
+
+    _grad_close(
+        lambda q, k, v: jnp.sum(ring(q, k, v).astype(jnp.float32) ** 2),
+        lambda q, k, v: jnp.sum(dense_reference(q, k, v, None, causal=True) ** 2),
+        (q, k, v),
+        BWD_RTOL_BF16,
+    )
+
+
+def test_zigzag_ring_matches_dense_on_tpu():
+    from stoke_tpu.ops import zigzag_ring_attention
+    from stoke_tpu.ops.flash_attention import (
+        BWD_RTOL_BF16,
+        FWD_ATOL_BF16,
+        dense_reference,
+    )
+
+    # one chip: the zigzag layout is the identity permutation (device 0
+    # holds both blocks), so outputs compare directly against dense causal
+    mesh = _mesh_1chip()
+    q, k, v = _qkv(np.random.default_rng(2))
+
+    def zz(q, k, v):
+        return zigzag_ring_attention(q, k, v, mesh=mesh, axis_name="seq")
+
+    out = zz(q, k, v)
+    ref = dense_reference(q, k, v, None, causal=True)
+    assert float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref))) < FWD_ATOL_BF16
+
+    _grad_close(
+        lambda q, k, v: jnp.sum(zz(q, k, v).astype(jnp.float32) ** 2),
+        lambda q, k, v: jnp.sum(dense_reference(q, k, v, None, causal=True) ** 2),
+        (q, k, v),
+        BWD_RTOL_BF16,
+    )
+
+
+def test_chunked_ce_matches_full_logits_on_tpu():
+    import optax
+
+    from stoke_tpu.ops import chunked_softmax_cross_entropy
+
+    r = np.random.default_rng(3)
+    B, L, H, V = 2, 512, 64, 1024
+    hidden = jnp.asarray(r.normal(size=(B, L, H)).astype(np.float32))
+    emb = jnp.asarray(r.normal(size=(V, H)).astype(np.float32) * 0.05)
+    targets = jnp.asarray(r.integers(0, V, size=(B, L)).astype(np.int32))
+    mask = jnp.asarray((r.random(size=(B, L)) > 0.1).astype(np.int32))
+
+    def full(hidden, emb):
+        logits = jnp.einsum("blh,vh->blv", hidden, emb)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        m = mask.astype(jnp.float32)
+        return jnp.sum(ce * m) / jnp.sum(m)
+
+    def chunked(hidden, emb):
+        return chunked_softmax_cross_entropy(
+            hidden, emb, targets, chunk=128, mask=mask
+        )
+
+    a = jax.jit(chunked)(hidden, emb)
+    b = jax.jit(full)(hidden, emb)
+    np.testing.assert_allclose(float(a), float(b), rtol=1e-5)
+    _grad_close(chunked, full, (hidden, emb), 1e-4)
