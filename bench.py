@@ -303,6 +303,14 @@ def main():
                     "best-known record at ANY segment length (it is a "
                     "tuning knob of the same metric, and keep-best may "
                     "legitimately have promoted a seg-50 record)")
+    ap.add_argument("--xla-flags", default="",
+                    help="extra XLA_FLAGS for the measurement (A/B autotune "
+                    "arms); applied in the worker BEFORE jax import.  An "
+                    "explicitly-flagged request is a distinct configuration "
+                    "(a record with different flags is never substituted "
+                    "for it); a default request accepts the best verified "
+                    "record whatever its flags — flags are a tuning knob "
+                    "of the same metric")
     ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
     if not args._worker:
@@ -319,8 +327,17 @@ def main():
                     if args.seg is not None and args.api == "train_steps"
                     else None
                 ),
+                # None = unconstrained (default run cites the best record
+                # whatever its flags); explicit flags must match exactly
+                "xla_flags": args.xla_flags or None,
             },
         ))
+
+    if args.xla_flags:
+        # must land before the jax import below initializes the backend
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + args.xla_flags
+        ).strip()
 
     import numpy as np
 
@@ -429,6 +446,8 @@ def main():
         "fresh": True,
         "measured_on": time.strftime("%Y-%m-%d"),
     }
+    if args.xla_flags:
+        result["xla_flags"] = args.xla_flags
     if on_accel:
         regression = check_regression(result["metric"], result["value"])
         if regression is not None:
@@ -458,6 +477,7 @@ def main():
                 "steps_per_dispatch": per_call,
                 "source": "bench.py fresh capture",
                 "backend": jax.default_backend(),
+                **({"xla_flags": args.xla_flags} if args.xla_flags else {}),
             },
             keep_best=True,
         )

@@ -96,8 +96,9 @@ def probe_bert(args) -> int:
 
     first_ema = None
     epochs = args.epochs
-    n_seq = n_tok = 0
-    t0 = None
+    n_seq = 0
+    masks = []  # device refs; summed AFTER timing (a per-batch host fetch
+    t0 = None   # would add one tunnel RTT per batch inside the window)
     for epoch in range(epochs):
         loader.set_epoch(epoch)
         for inputs, y in loader:
@@ -110,10 +111,11 @@ def probe_bert(args) -> int:
                 first_ema = float(stoke.ema_loss)
                 t0 = time.perf_counter()  # exclude compile from the rate
             else:
-                n_tok += int(np.asarray(inputs["attention_mask"]).sum())
+                masks.append(inputs["attention_mask"])
                 n_seq += y.shape[0]
     stoke.block_until_ready()
     dt = max(time.perf_counter() - t0, 1e-9)
+    n_tok = sum(int(np.asarray(m).sum()) for m in masks)
     rec = {
         "probe": "bert_seqcls",
         "size": size,
@@ -191,7 +193,7 @@ def probe_fp16_scaler(args) -> int:
     trajectory = []
     for i in range(args.steps):
         stoke.train_step(x, (y,))
-        scale = float(np.asarray(jax.device_get(stoke.loss_scale)))
+        scale = stoke.loss_scale  # facade already returns a host float
         trajectory.append(scale)
         print(json.dumps({
             "probe": "fp16_scaler", "step": i, "loss_scale": scale,

@@ -71,6 +71,20 @@ DEFAULT_QUEUE = ("bench", "flops_probe", "accuracy", "flash_tests",
                  "bert", "fp16_scaler", "profile", "bench_seg50",
                  "longcontext", "op_ring", "chunked_ce")
 
+#: XLA-flag A/B arms (VERDICT r4 item 2 lever).  XLA_FLAGS are fixed at
+#: backend init, so these CANNOT run inside the session worker's single
+#: interpreter — the non-jax parent runs each as its own supervised
+#: subprocess AFTER the main worker exits (never two tunnel clients at
+#: once), and only when the main session succeeded (a mid-run wedge means
+#: more dialing would deepen it).  bench.py records the flags in the
+#: ledger; keep-best promotes a faster arm to the headline automatically.
+FOLLOWUP_ARMS = (
+    # NB: the "=" form is required — argparse rejects a separate value
+    # token that itself starts with "--"
+    ("bench.py",
+     ["--xla-flags=--xla_tpu_enable_experimental_fusion_cost_model=true"]),
+)
+
 
 def main():
     ap = argparse.ArgumentParser()
@@ -93,8 +107,26 @@ def main():
             # slow phase); the 6h absolute cap is a backstop only — a
             # healthy-but-slow 7-item session must never be rationed into
             # a mid-stream kill (itself a relay-wedge trigger)
-            sys.exit(supervise(__file__, sys.argv[1:],
-                               watchdog_seconds=21600, idle_seconds=3600))
+            rc = supervise(__file__, sys.argv[1:],
+                           watchdog_seconds=21600, idle_seconds=3600)
+            if rc == 0 and args.only == ",".join(DEFAULT_QUEUE):
+                root = os.path.dirname(HERE)
+                for script, argv in FOLLOWUP_ARMS:
+                    print(json.dumps({"session": "followup",
+                                      "script": script, "argv": argv}),
+                          flush=True)
+                    arm_rc = supervise(os.path.join(root, script), argv,
+                                       watchdog_seconds=2400,
+                                       idle_seconds=1800)
+                    print(json.dumps({"session": "followup", "script": script,
+                                      "exit": arm_rc}), flush=True)
+                    if arm_rc != 0:
+                        # a killed arm may have wedged the relay — stop
+                        # dialing, and exit nonzero so the watcher backs
+                        # off instead of declaring the session complete
+                        rc = arm_rc
+                        break
+            sys.exit(rc)
         finally:
             if lock:
                 try:

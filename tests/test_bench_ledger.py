@@ -106,6 +106,20 @@ def test_check_regression_no_prior_record(ledger):
     assert bench.check_regression("never_measured", 1.0) is None
 
 
+def test_emit_persisted_xla_flags_rules(ledger, capsys):
+    # default request (flags unconstrained) accepts a flagged best record
+    bench.persist_result("m", {"value": 9000.0, "backend": "tpu",
+                               "api": "train_steps", "batch": 256,
+                               "xla_flags": "--xla_foo=true"})
+    rc, out = _emit(capsys, "m",
+                    requested={"api": "train_steps", "xla_flags": None})
+    assert rc == 0 and out["value"] == 9000.0
+    # an explicitly-flagged request never cites a record with other flags
+    rc, out = _emit(capsys, "m",
+                    requested={"xla_flags": "--xla_bar=true"})
+    assert rc == 1 and out["value"] == 0.0
+
+
 def test_persist_result_keep_best(ledger):
     bench.persist_result("m", {"value": 9000.0, "backend": "tpu"})
     # slower result with keep_best never clobbers the faster record
