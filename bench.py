@@ -141,6 +141,7 @@ def _emit_persisted(metric: str, capture_error: str,
             "api": rec.get("api"),
             "batch": rec.get("batch"),
             "steps_per_dispatch": rec.get("steps_per_dispatch"),
+            "xla_flags": rec.get("xla_flags"),
             "capture_error": capture_error,
             "note": "persisted last verified on-chip measurement "
             "(fresh capture failed; see capture_error and BENCH_NOTES.md)",
@@ -189,6 +190,55 @@ def check_regression(metric: str, value: float) -> dict | None:
 #: sentinel: probe succeeded but only the CPU backend is visible
 _CPU_ONLY = "cpu-only"
 
+#: single-client tunnel coordination lock shared with scripts/tpu_session.py
+#: and scripts/tunnel_watch.sh (BENCH_NOTES.md "Tunnel discipline")
+_TUNNEL_LOCK = "/tmp/tpu_in_use"
+
+
+def _lock_holder_alive() -> int | None:
+    """PID of a LIVE process holding the tunnel lock, else None (no lock,
+    unreadable lock, or stale lock from a dead holder)."""
+    try:
+        with open(_TUNNEL_LOCK) as f:
+            pid = int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return None
+    if pid <= 0 or pid == os.getpid():
+        return None
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return None
+    except PermissionError:
+        pass  # exists but not ours — still alive
+    return pid
+
+
+def _try_acquire_tunnel_lock() -> tuple[bool, int | None]:
+    """Atomically take the tunnel lock (O_CREAT|O_EXCL — a check-then-write
+    would race another client and clobber its lock).  Returns
+    ``(taken, live_holder_pid)``: on EEXIST a live holder is reported, a
+    stale lock (dead holder) is removed and the acquire retried once.  A
+    filesystem error yields (False, None) — proceed unlocked rather than
+    refusing to measure."""
+    for _ in range(2):
+        try:
+            fd = os.open(_TUNNEL_LOCK, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            with os.fdopen(fd, "w") as f:
+                f.write(str(os.getpid()))
+            return True, None
+        except FileExistsError:
+            pid = _lock_holder_alive()
+            if pid is not None:
+                return False, pid
+            try:
+                os.remove(_TUNNEL_LOCK)
+            except OSError:
+                return False, None
+        except OSError:
+            return False, None
+    return False, None
+
 
 def _probe_devices() -> str | None:
     """Check the accelerator is reachable.  Returns None when an accelerator
@@ -234,18 +284,35 @@ def _supervise(argv, preset: str, requested: dict | None = None) -> int:
     # the tiny preset is a CPU-safe smoke of a different metric — never
     # substitute the persisted full-ResNet number for it
     run_metric = "cifar10_basicnn_train_throughput" if preset == "tiny" else METRIC
-    err = _probe_devices()
-    if err == _CPU_ONLY and preset != "tiny":
-        # don't burn the watchdog on a CPU ResNet-50 run whose result the
-        # on_accelerator check would discard anyway
-        return _emit_persisted(
-            run_metric,
-            "device probe found CPU-only backend (no TPU visible)",
-            requested,
-        )
-    if err is not None and err != _CPU_ONLY:
-        return _emit_persisted(run_metric, err, requested)
+    # Take the single-client tunnel lock BEFORE dialing anything (the probe
+    # itself is a client).  A live holder means the measurement session is
+    # busy writing the very records this run would cite — emit the
+    # persisted number instead of racing it (dialing a second client is
+    # the documented wedge trigger).
+    lock_taken = False
+    if preset != "tiny":
+        lock_taken, holder = _try_acquire_tunnel_lock()
+        if not lock_taken and holder is not None:
+            return _emit_persisted(
+                run_metric,
+                f"tunnel held by live measurement session (pid {holder}); "
+                f"not dialing a second client into the single-client relay",
+                requested,
+            )
+    # the lock is held through probe AND measurement so the background
+    # watcher's periodic probe never dials a second client mid-run
     try:
+        err = _probe_devices()
+        if err == _CPU_ONLY and preset != "tiny":
+            # don't burn the watchdog on a CPU ResNet-50 run whose result
+            # the on_accelerator check would discard anyway
+            return _emit_persisted(
+                run_metric,
+                "device probe found CPU-only backend (no TPU visible)",
+                requested,
+            )
+        if err is not None and err != _CPU_ONLY:
+            return _emit_persisted(run_metric, err, requested)
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--_worker", *argv],
             capture_output=True,
@@ -279,6 +346,12 @@ def _supervise(argv, preset: str, requested: dict | None = None) -> int:
         detail = err_lines[-1][:200] if err_lines else "unknown"
     except subprocess.TimeoutExpired:
         detail = f"timeout after {WATCHDOG_SECONDS}s (TPU tunnel wedged?)"
+    finally:
+        if lock_taken:
+            try:
+                os.remove(_TUNNEL_LOCK)
+            except OSError:
+                pass
     return _emit_persisted(run_metric, detail, requested)
 
 

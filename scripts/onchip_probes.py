@@ -97,8 +97,10 @@ def probe_bert(args) -> int:
     first_ema = None
     epochs = args.epochs
     n_seq = 0
-    masks = []  # device refs; summed AFTER timing (a per-batch host fetch
-    t0 = None   # would add one tunnel RTT per batch inside the window)
+    # token count stays ON DEVICE during timing (async .sum() dispatches,
+    # no blocking fetch, no retained mask buffers); ONE fetch at the end
+    n_tok_dev = None
+    t0 = None
     for epoch in range(epochs):
         loader.set_epoch(epoch)
         for inputs, y in loader:
@@ -111,11 +113,12 @@ def probe_bert(args) -> int:
                 first_ema = float(stoke.ema_loss)
                 t0 = time.perf_counter()  # exclude compile from the rate
             else:
-                masks.append(inputs["attention_mask"])
+                s = inputs["attention_mask"].sum()
+                n_tok_dev = s if n_tok_dev is None else n_tok_dev + s
                 n_seq += y.shape[0]
     stoke.block_until_ready()
     dt = max(time.perf_counter() - t0, 1e-9)
-    n_tok = sum(int(np.asarray(m).sum()) for m in masks)
+    n_tok = 0 if n_tok_dev is None else int(jax.device_get(n_tok_dev))
     rec = {
         "probe": "bert_seqcls",
         "size": size,

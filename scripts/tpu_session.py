@@ -83,6 +83,10 @@ FOLLOWUP_ARMS = (
     # token that itself starts with "--"
     ("bench.py",
      ["--xla-flags=--xla_tpu_enable_experimental_fusion_cost_model=true"]),
+    # single-chip effect expected small (no collectives to hide), but the
+    # scheduler also reorders HBM prefetch against compute — worth one arm
+    ("bench.py",
+     ["--xla-flags=--xla_tpu_enable_latency_hiding_scheduler=true"]),
 )
 
 

@@ -11,12 +11,19 @@ cd "$(dirname "$0")/.."
 # single-client relay is itself a wedge trigger
 exec 9>/tmp/tunnel_watch.lock
 flock -n 9 || { echo "another tunnel_watch is already running; exiting"; exit 0; }
-LOG=/tmp/tpu_session_r04.log
+LOG=${TPU_SESSION_LOG:-/tmp/tpu_session_r05.log}
 while true; do
   if [ -f /tmp/tpu_in_use ]; then
-    echo "$(date -u +%H:%M:%S) session holds tunnel; sleeping"
-    sleep 600
-    continue
+    # liveness, not bare existence: a SIGKILLed session never runs its
+    # finally, and a stale lock would otherwise idle the watcher forever
+    pid=$(cat /tmp/tpu_in_use 2>/dev/null)
+    if [ -n "$pid" ] && kill -0 "$pid" 2>/dev/null; then
+      echo "$(date -u +%H:%M:%S) session (pid $pid) holds tunnel; sleeping"
+      sleep 600
+      continue
+    fi
+    echo "$(date -u +%H:%M:%S) stale tunnel lock (pid ${pid:-?} dead); removing"
+    rm -f /tmp/tpu_in_use
   fi
   echo "$(date -u +%H:%M:%S) probing tunnel..."
   if timeout 125 python -c "import jax; assert jax.devices()[0].platform != 'cpu', jax.devices(); print('ALIVE', jax.devices())"; then

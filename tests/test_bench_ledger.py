@@ -120,6 +120,30 @@ def test_emit_persisted_xla_flags_rules(ledger, capsys):
     assert rc == 1 and out["value"] == 0.0
 
 
+def test_lock_holder_alive(tmp_path, monkeypatch):
+    import os
+    import subprocess
+
+    lock = tmp_path / "tpu_in_use"
+    monkeypatch.setattr(bench, "_TUNNEL_LOCK", str(lock))
+    # no lock file
+    assert bench._lock_holder_alive() is None
+    # own pid never counts as another holder
+    lock.write_text(str(os.getpid()))
+    assert bench._lock_holder_alive() is None
+    # stale lock from a dead process
+    p = subprocess.Popen(["true"])
+    p.wait()
+    lock.write_text(str(p.pid))
+    assert bench._lock_holder_alive() is None
+    # live holder (this test's parent process)
+    lock.write_text(str(os.getppid()))
+    assert bench._lock_holder_alive() == os.getppid()
+    # garbage content
+    lock.write_text("not-a-pid")
+    assert bench._lock_holder_alive() is None
+
+
 def test_persist_result_keep_best(ledger):
     bench.persist_result("m", {"value": 9000.0, "backend": "tpu"})
     # slower result with keep_best never clobbers the faster record
