@@ -7,7 +7,7 @@
 CPU_ENV = env PYTHONPATH=$(CURDIR) JAX_PLATFORMS=cpu
 MESH_ENV = $(CPU_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-full test-fast dryrun bench-smoke tpu-probe
+.PHONY: test test-full test-fast test-telemetry dryrun bench-smoke telemetry-smoke tpu-probe
 
 test:            ## default tier (excludes @slow compile-heavy equivalence tests)
 	$(MESH_ENV) python -m pytest tests/ -x -q
@@ -21,8 +21,14 @@ test-fast:       ## quick subset (status/facade/data), CPU mesh
 dryrun:          ## multi-chip sharding dry-run on 8 virtual devices
 	$(MESH_ENV) python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 
+test-telemetry:  ## observability-subsystem tests only (CPU, deterministic)
+	$(MESH_ENV) python -m pytest tests/ -x -q -m telemetry
+
 bench-smoke:     ## CPU-safe bench smoke (never touches the tunnel)
 	$(CPU_ENV) python bench.py --preset tiny
+
+telemetry-smoke: ## one JSONL-emitting CPU train step through the full telemetry pipeline
+	$(CPU_ENV) python scripts/telemetry_smoke.py
 
 tpu-probe:       ## 60s health probe of the real chip (tunnel-safe timeout)
 	timeout 60 python -c "import jax; print(jax.devices())"

@@ -167,7 +167,15 @@ def _emit_persisted(metric: str, capture_error: str,
 REGRESSION_TOLERANCE = 0.05
 
 
-def check_regression(metric: str, value: float) -> dict | None:
+#: capture-config keys whose mismatch vs the ledger best marks a comparison
+#: as cross-configuration (A/B arms, seg sweeps) rather than a like-for-like
+#: regression
+_REGRESSION_CONFIG_KEYS = ("xla_flags", "steps_per_dispatch")
+
+
+def check_regression(
+    metric: str, value: float, config: dict | None = None
+) -> dict | None:
     """Compare a FRESH capture against the ledger best for ``metric``.
 
     Returns a regression descriptor when ``value`` is more than
@@ -175,15 +183,37 @@ def check_regression(metric: str, value: float) -> dict | None:
     round surfaces the round it happens — VERDICT r4 item 8), else None.
     Records measured under a different api/batch are still comparable: the
     ledger best IS the headline the metric is judged by.
+
+    ``config`` carries this capture's ``xla_flags``/``steps_per_dispatch``;
+    when those differ from the ledger best's the descriptor is tagged
+    ``config_differs: true`` (with both configurations inlined) — an A/B
+    arm or seg-sweep running slower than a differently-configured best is
+    an expected experiment outcome, not a like-for-like REGRESSION, and
+    consumers should not alarm on it (ADVICE low).
     """
-    best = _load_results().get(metric, {}).get("value", 0.0)
+    best_rec = _load_results().get(metric, {})
+    best = best_rec.get("value", 0.0)
     if best > 0 and value < best * (1.0 - REGRESSION_TOLERANCE):
-        return {
+        out = {
             "best": best,
             "ratio": round(value / best, 4),
             "note": f"fresh capture regressed >{REGRESSION_TOLERANCE:.0%} "
             f"below the ledger best ({value} vs {best})",
         }
+        if config is not None:
+            differing = {
+                key: {"capture": config.get(key), "best": best_rec.get(key)}
+                for key in _REGRESSION_CONFIG_KEYS
+                if config.get(key) != best_rec.get(key)
+            }
+            if differing:
+                out["config_differs"] = True
+                out["config_diff"] = differing
+                out["note"] += (
+                    " [capture and ledger-best configurations differ "
+                    "(A/B arm?); not a like-for-like regression]"
+                )
+        return out
     return None
 
 
@@ -237,7 +267,12 @@ def _try_acquire_tunnel_lock() -> tuple[bool, int | None]:
                 return False, None
         except OSError:
             return False, None
-    return False, None
+    # loop exhausted: another client re-created the lock between our stale
+    # removal and the retry.  Report its (live) pid instead of (False, None)
+    # — a None holder reads as "filesystem error, proceed unlocked", which
+    # would dial a second client into the single-client relay right as the
+    # winner starts measuring (ADVICE low).
+    return False, _lock_holder_alive()
 
 
 def _probe_devices() -> str | None:
@@ -522,7 +557,14 @@ def main():
     if args.xla_flags:
         result["xla_flags"] = args.xla_flags
     if on_accel:
-        regression = check_regression(result["metric"], result["value"])
+        regression = check_regression(
+            result["metric"],
+            result["value"],
+            config={
+                "xla_flags": args.xla_flags or None,
+                "steps_per_dispatch": per_call,
+            },
+        )
         if regression is not None:
             # loud, structured, and on both streams: the JSON line carries
             # the flag for the driver, stderr for a human scanning logs

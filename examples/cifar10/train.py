@@ -106,6 +106,16 @@ def build_stoke(cfg: dict) -> Stoke:
         configs.append(OSSConfig())
     if cfg.get("sddp"):
         configs.append(SDDPConfig())
+    if cfg.get("telemetry"):
+        # telemetry: {output_dir: runs/exp/telemetry, log_every_n_steps: 10}
+        # — or just `telemetry: true` for the defaults (docs/observability.md)
+        from stoke_tpu import TelemetryConfig
+
+        spec = cfg["telemetry"]
+        configs.append(
+            TelemetryConfig(**spec) if isinstance(spec, dict)
+            else TelemetryConfig()
+        )
     return Stoke(
         model=model,
         optimizer=StokeOptimizer(

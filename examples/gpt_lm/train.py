@@ -60,6 +60,11 @@ def main():
     ap.add_argument("--n-samples", type=int, default=2048)
     ap.add_argument("--lr", type=float, default=3e-3)
     ap.add_argument("--fsdp", action="store_true")
+    ap.add_argument("--telemetry", default=None, metavar="DIR",
+                    help="enable the unified telemetry pipeline with this "
+                    "output dir (JSONL step events incl. tokens/sec, "
+                    "Prometheus exposition, recompile/HBM tracking — "
+                    "docs/observability.md)")
     args = ap.parse_args()
 
     attention_fn, is_causal, mesh_cfgs = None, False, []
@@ -89,6 +94,13 @@ def main():
     corpus = make_corpus(args.n_samples, args.seq_len)
     variables = init_module(model, jax.random.PRNGKey(0), corpus[:2], train=False)
 
+    configs = list(mesh_cfgs)
+    if args.telemetry:
+        from stoke_tpu import TelemetryConfig
+
+        configs.append(TelemetryConfig(
+            output_dir=args.telemetry, log_every_n_steps=10, tensorboard=True,
+        ))
     stoke = Stoke(
         model=model,
         optimizer=StokeOptimizer(
@@ -103,7 +115,7 @@ def main():
         distributed=args.distributed,
         precision=args.precision,
         fsdp=args.fsdp,
-        configs=mesh_cfgs,
+        configs=configs,
         model_train_kwargs={"train": True},
         model_eval_kwargs={"train": False},
     )
@@ -112,7 +124,11 @@ def main():
         t0, n_tok = time.time(), 0
         for batch in loader:
             stoke.train_step(batch, batch)
-            n_tok += batch.shape[0] * batch.shape[1]
+            step_tokens = batch.shape[0] * batch.shape[1]
+            n_tok += step_tokens
+            if args.telemetry:
+                # feed tokens/sec into the step events (data/tokens_total)
+                stoke.telemetry.add_tokens(step_tokens)
         stoke.block_until_ready()
         dt = time.time() - t0
         stoke.print_on_devices(

@@ -248,8 +248,31 @@ def main():
                     help="fp16 scaler probe steps")
     args = ap.parse_args()
     if not args._worker:
-        sys.exit(supervise(__file__, sys.argv[1:], watchdog_seconds=3000,
-                           idle_seconds=1200))
+        # Standalone supervised runs must hold the single-client tunnel
+        # lock for their whole duration — otherwise the background watcher
+        # (or a parallel bench) dials a second client into the relay
+        # mid-probe, the documented wedge trigger (ADVICE low).  Inside
+        # tpu_session.py the session parent already holds the lock and the
+        # probes run as plain workers, so this path is standalone-only.
+        import bench
+
+        taken, holder = bench._try_acquire_tunnel_lock()
+        if not taken and holder is not None:
+            print(json.dumps({
+                "probe": "backoff",
+                "error": f"tunnel held by live session (pid {holder}); "
+                f"not dialing a second client into the single-client relay",
+            }), flush=True)
+            sys.exit(75)  # EX_TEMPFAIL: retry later
+        try:
+            sys.exit(supervise(__file__, sys.argv[1:], watchdog_seconds=3000,
+                               idle_seconds=1200))
+        finally:
+            if taken:
+                try:
+                    os.remove(bench._TUNNEL_LOCK)
+                except OSError:
+                    pass
     failures = 0
     for name in args.only.split(","):
         try:

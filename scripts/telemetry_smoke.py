@@ -1,0 +1,80 @@
+"""Telemetry smoke: ONE CPU train step with the full pipeline enabled.
+
+Proves the observability stack end-to-end in seconds (``make
+telemetry-smoke``): a JSONL step record (schema-validated on read-back), a
+Prometheus exposition file, and a TB event stream readable by the native
+frame parser.  Prints the step record and a one-line verdict; exit 0 only
+when all three sinks round-trip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import numpy as np
+    import optax
+
+    from stoke_tpu import Stoke, StokeOptimizer, TelemetryConfig
+    from stoke_tpu.telemetry import read_step_events
+    from stoke_tpu.utils.tb_writer import read_scalar_events
+
+    out_dir = os.environ.get(
+        "STOKE_TELEMETRY_SMOKE_DIR",
+        tempfile.mkdtemp(prefix="stoke-telemetry-smoke-"),
+    )
+    cfg = TelemetryConfig(
+        output_dir=out_dir,
+        log_every_n_steps=1,
+        tensorboard=True,
+        grad_norm=True,
+    )
+    stoke = Stoke(
+        model=lambda p, x: x @ p["w"],
+        optimizer=StokeOptimizer(
+            optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.1}
+        ),
+        loss=lambda o, y: ((o - y) ** 2).mean(),
+        params={"w": np.ones((8, 4), np.float32)},
+        batch_size_per_device=16,
+        configs=[cfg],
+        verbose=False,
+    )
+    x = np.ones((16, 8), np.float32)
+    y = np.zeros((16, 4), np.float32)
+    stoke.train_step(x, (y,))
+    stoke.close_telemetry()
+
+    records = read_step_events(os.path.join(out_dir, "steps.jsonl"))
+    print(json.dumps(records[-1], sort_keys=True))
+    prom = open(os.path.join(out_dir, "metrics.prom")).read()
+    tb_dir = os.path.join(out_dir, "tb")
+    tb_files = [
+        os.path.join(tb_dir, f) for f in os.listdir(tb_dir)
+        if f.startswith("events.out.tfevents.")
+    ]
+    tb_events = read_scalar_events(tb_files[0]) if tb_files else []
+    ok = (
+        len(records) == 1
+        and records[0]["step"] == 1
+        and "stoke_jax_compiles_total" in prom
+        and any(t.startswith("telemetry/") for t, _, _ in tb_events)
+    )
+    print(json.dumps({
+        "telemetry_smoke": "ok" if ok else "FAILED",
+        "output_dir": out_dir,
+        "jsonl_records": len(records),
+        "prom_bytes": len(prom),
+        "tb_scalars": len(tb_events),
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -97,14 +97,38 @@ def main():
                     help="comma-separated subset of: " + ", ".join(QUEUE))
     args = ap.parse_args()
     if not args._worker:
-        # pause any background tunnel watcher while the session holds the
-        # (single-client) tunnel
-        lock = "/tmp/tpu_in_use"
-        try:
-            with open(lock, "w") as f:
-                f.write(str(os.getpid()))
-        except OSError:
-            lock = None
+        # Take the single-client tunnel lock ATOMICALLY (bench.py's
+        # O_CREAT|O_EXCL + live-holder check) — the previous plain
+        # ``open('w')`` silently clobbered a live measurement session's
+        # lock, which is exactly the second-client dial the lock exists to
+        # prevent (ADVICE medium).  A live holder means the tunnel is busy:
+        # back off and exit nonzero so the caller/watcher retries later
+        # instead of wedging the relay.
+        import bench
+
+        taken, holder = bench._try_acquire_tunnel_lock()
+        if not taken and holder is not None:
+            print(json.dumps({
+                "session": "backoff",
+                "error": f"tunnel held by live session (pid {holder}); "
+                f"refusing to dial a second client into the single-client "
+                f"relay",
+            }), flush=True)
+            sys.exit(75)  # EX_TEMPFAIL: retryable, not a failure of the queue
+        # taken, or filesystem error (holder None): in the latter case
+        # proceed unlocked — refusing to measure over a lock-file IO error
+        # would starve the queue forever
+        lock = bench._TUNNEL_LOCK if taken else None
+
+        def _release_lock():
+            nonlocal lock
+            if lock:
+                try:
+                    os.remove(lock)
+                except OSError:
+                    pass
+                lock = None
+
         try:
             # hang detection is idle-based (every queue item prints a JSON
             # line per phase; 1h of silence on a chip means a hang, not a
@@ -113,6 +137,11 @@ def main():
             # a mid-stream kill (itself a relay-wedge trigger)
             rc = supervise(__file__, sys.argv[1:],
                            watchdog_seconds=21600, idle_seconds=3600)
+            # the lock stays held through the follow-up arms: supervise()
+            # spawns them in --_worker mode (scripts/_supervise.py), which
+            # skips bench's own lock-taking supervisor path — releasing
+            # here would leave the relay unguarded and let the background
+            # watcher dial a second client mid-arm
             if rc == 0 and args.only == ",".join(DEFAULT_QUEUE):
                 root = os.path.dirname(HERE)
                 for script, argv in FOLLOWUP_ARMS:
@@ -132,11 +161,7 @@ def main():
                         break
             sys.exit(rc)
         finally:
-            if lock:
-                try:
-                    os.remove(lock)
-                except OSError:
-                    pass
+            _release_lock()
 
     root = os.path.dirname(HERE)
     failures = 0

@@ -29,6 +29,7 @@ from stoke_tpu.configs import (
     PrecisionOptions,
     ProfilerConfig,
     SDDPConfig,
+    TelemetryConfig,
     TensorboardConfig,
     ShardingOptions,
     StokeOptimizer,
@@ -87,6 +88,7 @@ __all__ = [
     "ActivationCheckpointingConfig",
     "CheckpointConfig",
     "ProfilerConfig",
+    "TelemetryConfig",
     "TensorboardConfig",
     # adapters
     "ModelAdapter",

@@ -536,6 +536,57 @@ class TensorboardConfig:
 
 
 @dataclass
+class TelemetryConfig:
+    """Unified telemetry pipeline (``stoke_tpu.telemetry``): metrics
+    registry + structured step events + scrape-able exposition.
+
+    Supplying this config turns on the whole observability stack for a run:
+    facade phase timers, data-loader wait/starvation accounting, XLA
+    compile/recompile tracking, HBM high-watermark gauges, and labeled
+    xprof spans feed one registry, drained at ``log_every_n_steps`` into
+    the enabled sinks.  No reference equivalent (the reference's metrics
+    story was DeepSpeed tensorboard passthrough, configs.py:392-405).
+
+    Attributes:
+        output_dir: directory for all sink outputs (``steps.jsonl``,
+            ``metrics.prom``, ``tb/``).
+        run_name: label stamped into the Prometheus exposition.
+        log_every_n_steps: optimizer-step cadence for step records.
+        jsonl: write structured step events (one JSON line per window).
+        jsonl_all_ranks: multi-host — every process writes its own
+            ``steps.rank<N>.jsonl`` (default: rank 0 only, like all sinks).
+        prometheus: write the atomic text-exposition scrape file.
+        tensorboard: mirror step events into a native TB event stream
+            under ``output_dir/tb`` (independent of ``TensorboardConfig``,
+            which keeps driving the legacy loss/scaler scalars).
+        sample_device_time: bracket one dispatch per logging window with
+            ``block_until_ready`` to sample true device step time (one
+            host sync per window — off for maximally async loops).
+        grad_norm: compute the global gradient-buffer norm at each record
+            boundary (one extra device reduction per window).
+        track_compiles: count XLA backend compiles / recompiles via
+            ``jax.monitoring`` listeners.
+        track_hbm: refresh HBM high-watermark gauges from
+            ``device.memory_stats()`` at each record.
+        xprof_annotations: label engine phases in xprof timelines via
+            ``jax.profiler.TraceAnnotation`` (nearly free outside traces).
+    """
+
+    output_dir: str = "telemetry"
+    run_name: str = "stoke"
+    log_every_n_steps: int = 10
+    jsonl: bool = True
+    jsonl_all_ranks: bool = False
+    prometheus: bool = True
+    tensorboard: bool = False
+    sample_device_time: bool = True
+    grad_norm: bool = False
+    track_compiles: bool = True
+    track_hbm: bool = True
+    xprof_annotations: bool = True
+
+
+@dataclass
 class ProfilerConfig:
     """First-class profiling (SURVEY.md §5: native win over the reference's
     DeepSpeed flops-profiler passthrough, configs.py:252-279).
@@ -591,6 +642,7 @@ ALL_CONFIG_CLASSES: Tuple[type, ...] = (
     ActivationCheckpointingConfig,
     CheckpointConfig,
     ProfilerConfig,
+    TelemetryConfig,
     TensorboardConfig,
 )
 

@@ -322,6 +322,12 @@ class StokeDataLoader:
             double buffering).  Transfers are async dispatches; lookahead
             overlaps host→HBM copy with device compute.
         place: set False to get host batches (escape hatch).
+        telemetry: optional ``stoke_tpu.telemetry.Telemetry`` — the loader
+            then accounts host-loader wait time (``data/loader_wait_s``)
+            and post-warmup starvation (``data/starvation_s``: time the
+            training loop sat blocked on ``next()`` after the prefetch
+            window was primed — the input-pipeline-bound signal) into its
+            registry.  Wired automatically by ``Stoke.DataLoader``.
     """
 
     def __init__(
@@ -331,10 +337,12 @@ class StokeDataLoader:
         place_fn: Optional[Callable] = None,
         prefetch: int = 2,
         place: bool = True,
+        telemetry=None,
         **kwargs,
     ):
         self._place_fn = place_fn if place else None
         self._prefetch = max(int(prefetch), 1)
+        self._telemetry = telemetry
         self.batch_size = batch_size
         if isinstance(dataset, ArrayDataset):
             # native fast path: one GIL-free row-gather per array per batch
@@ -379,22 +387,70 @@ class StokeDataLoader:
         if s is not None and hasattr(s, "set_epoch"):
             s.set_epoch(epoch)
 
+    def _next_timed(self, it, wait_counter, starve_counter=None):
+        """``next(it)`` with host-loader wait accounting: all wait lands in
+        ``data/loader_wait_s``; post-warmup wait additionally counts as
+        starvation (the device had nothing prefetched to hide it behind)."""
+        import time
+
+        t0 = time.perf_counter()
+        try:
+            return next(it)
+        finally:
+            dt = time.perf_counter() - t0
+            wait_counter.inc(dt)
+            if starve_counter is not None:
+                starve_counter.inc(dt)
+
     def __iter__(self):
+        if self._telemetry is None:
+            yield from self._iter_batches()
+            return
+        reg = self._telemetry.registry
+        wait = reg.counter(
+            "data/loader_wait_s",
+            help="host seconds blocked on the host-side loader",
+        )
+        starve = reg.counter(
+            "data/starvation_s",
+            help="post-warmup loader wait (device-starving portion)",
+        )
+        yield from self._iter_batches(wait, starve)
+
+    def _iter_batches(self, wait_counter=None, starve_counter=None):
+        from stoke_tpu.telemetry.collectors import xprof_span
+
+        def fetch(it, warm: bool):
+            with xprof_span("stoke/io"):
+                if wait_counter is None:
+                    return next(it)
+                return self._next_timed(
+                    it, wait_counter, starve_counter if warm else None
+                )
+
         if self._place_fn is None:
-            yield from self._loader
+            it = iter(self._loader)
+            warm = False
+            while True:
+                try:
+                    batch = fetch(it, warm)
+                except StopIteration:
+                    return
+                warm = True
+                yield batch
             return
         # lookahead pipeline: keep `prefetch` placed batches in flight
         queue: List[Any] = []
         it = iter(self._loader)
         try:
             for _ in range(self._prefetch):
-                queue.append(self._place_fn(next(it)))
+                queue.append(self._place_fn(fetch(it, warm=False)))
         except StopIteration:
             pass
         while queue:
             out = queue.pop(0)
             try:
-                queue.append(self._place_fn(next(it)))
+                queue.append(self._place_fn(fetch(it, warm=True)))
             except StopIteration:
                 pass
             yield out

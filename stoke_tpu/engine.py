@@ -49,6 +49,7 @@ from stoke_tpu.configs import (
     StokeOptimizer,
 )
 from stoke_tpu.parallel.sharding import ShardingRules, place_global_tree
+from stoke_tpu.telemetry.collectors import xprof_span
 from stoke_tpu.utils.trees import tree_cast, tree_finite, tree_zeros_like
 
 
@@ -416,6 +417,13 @@ class StepEngine:
         self._fwd_cache: Dict[Any, Callable] = {}
         self._loss_cache: Dict[Any, Callable] = {}
         self._apply_fn: Optional[Callable] = None
+        # input-shape signatures per compiled program, for structural
+        # recompile detection (telemetry): a warm program dispatched with a
+        # NEW signature forces a silent XLA recompile.  The facade assigns
+        # THIS engine's tracker (instance-scoped: another facade's shape
+        # churn in the same process must not be charged to this run)
+        self._shape_sigs: Dict[Any, set] = {}
+        self._compile_tracker = None
         # shardings, resolved lazily once variables are known
         self._var_shardings = None
         self._grad_shardings = None
@@ -597,6 +605,7 @@ class StepEngine:
                 return out
 
             self._fwd_cache[key] = _fwd
+        self._note_dispatch_shapes(key, margs, mkwargs)
         return self._fwd_cache[key](variables, rng, margs, mkwargs)
 
     def eval_fwd(self, variables, margs: tuple, mkwargs: dict):
@@ -616,7 +625,39 @@ class StepEngine:
                 return self.precision.cast_output(out)
 
             self._fwd_cache[key] = _efwd
+        self._note_dispatch_shapes(key, margs, mkwargs)
         return self._fwd_cache[key](variables, margs, mkwargs)
+
+    #: per-program cap on remembered shape signatures: beyond this the
+    #: membership test can no longer distinguish new shapes from evicted
+    #: ones, so detection FREEZES for that program (no more counting —
+    #: repeat-counting already-compiled shapes would be a permanent false
+    #: alarm) and host memory stays bounded under pathological shape churn
+    _MAX_SHAPE_SIGS = 1024
+
+    def _note_dispatch_shapes(self, key, *batch_trees) -> None:
+        """Telemetry hook: record the input-shape signature of a dispatch.
+        First signature per program = warm-up compile; any LATER new
+        signature means XLA silently recompiles the warm program (ragged
+        batch / drifting pad length) — reported to THIS engine's
+        ``CompileTracker`` (assigned by the facade; no bookkeeping at all
+        when telemetry is off)."""
+        tracker = self._compile_tracker
+        if tracker is None:
+            return
+        seen = self._shape_sigs.setdefault(key, set())
+        if len(seen) >= self._MAX_SHAPE_SIGS:
+            return
+        sig = tuple(
+            (tuple(l.shape), str(getattr(l, "dtype", "")))
+            for l in jax.tree_util.tree_leaves(batch_trees)
+            if hasattr(l, "shape")
+        )
+        if sig in seen:
+            return
+        if seen:
+            tracker.note_recompile()
+        seen.add(sig)
 
     # -------------------------- fused micro-step ----------------------- #
 
@@ -655,9 +696,12 @@ class StepEngine:
             self._accum_cache[struct_key] = self._build_accum(
                 loss_treedef, deferred_info, training
             )
-        return self._accum_cache[struct_key](
-            variables, grad_buf, scaler_state, rng, margs, mkwargs, loss_args_flat
-        )
+        self._note_dispatch_shapes(struct_key, margs, mkwargs, loss_args_flat)
+        with xprof_span("stoke/accum"):
+            return self._accum_cache[struct_key](
+                variables, grad_buf, scaler_state, rng, margs, mkwargs,
+                loss_args_flat,
+            )
 
     def _accum_core(self, loss_treedef, deferred_info, training):
         """Unjitted micro-step core: forward + loss + grad + buffer add.
@@ -882,10 +926,14 @@ class StepEngine:
         )
         if key not in self._accum_cache:
             self._accum_cache[key] = self._build_window(loss_treedef, deferred_info)
-        return self._accum_cache[key](
-            variables, opt_state, grad_buf, scaler_state, rng,
-            margs_stacked, mkwargs_stacked, loss_args_flat_stacked,
+        self._note_dispatch_shapes(
+            key, margs_stacked, mkwargs_stacked, loss_args_flat_stacked
         )
+        with xprof_span("stoke/dispatch"):
+            return self._accum_cache[key](
+                variables, opt_state, grad_buf, scaler_state, rng,
+                margs_stacked, mkwargs_stacked, loss_args_flat_stacked,
+            )
 
     def _window_core(self, loss_treedef, deferred_info):
         """Unjitted whole-window core: inner ``lax.scan`` over the stacked
@@ -978,10 +1026,14 @@ class StepEngine:
         )
         if key not in self._accum_cache:
             self._accum_cache[key] = self._build_multi(loss_treedef, deferred_info)
-        return self._accum_cache[key](
-            variables, opt_state, grad_buf, scaler_state, rng,
-            margs_stacked, mkwargs_stacked, loss_args_flat_stacked,
+        self._note_dispatch_shapes(
+            key, margs_stacked, mkwargs_stacked, loss_args_flat_stacked
         )
+        with xprof_span("stoke/dispatch"):
+            return self._accum_cache[key](
+                variables, opt_state, grad_buf, scaler_state, rng,
+                margs_stacked, mkwargs_stacked, loss_args_flat_stacked,
+            )
 
     def _build_multi(self, loss_treedef, deferred_info):
         window = self._window_core(loss_treedef, deferred_info)
@@ -1040,7 +1092,8 @@ class StepEngine:
         stoke.py:990-1040 + fp16.py:788-806)."""
         if self._apply_fn is None:
             self._apply_fn = self._build_apply()
-        return self._apply_fn(variables, opt_state, grad_buf, scaler_state)
+        with xprof_span("stoke/step"):
+            return self._apply_fn(variables, opt_state, grad_buf, scaler_state)
 
     def _apply_core(self):
         """Unjitted apply core, shared by step() and the fused train_step."""
@@ -1158,19 +1211,22 @@ class StepEngine:
             self._accum_cache[key] = self._build_fused(
                 loss_treedef, deferred_info, bool(do_apply)
             )
+        self._note_dispatch_shapes(key, margs, mkwargs, loss_args_flat)
         if do_apply:
-            return self._accum_cache[key](
-                variables, opt_state, grad_buf, scaler_state, rng, margs,
-                mkwargs, loss_args_flat,
-            )
+            with xprof_span("stoke/dispatch"):
+                return self._accum_cache[key](
+                    variables, opt_state, grad_buf, scaler_state, rng, margs,
+                    mkwargs, loss_args_flat,
+                )
         # non-boundary micro-steps never touch the optimizer state: it stays
         # wherever it lives (device, pinned host, or the disk tier) and the
         # caller's reference is echoed untouched
-        (report, updated, new_vars, new_buf, new_scaler, new_rng,
-         finite) = self._accum_cache[key](
-            variables, grad_buf, scaler_state, rng, margs, mkwargs,
-            loss_args_flat,
-        )
+        with xprof_span("stoke/dispatch"):
+            (report, updated, new_vars, new_buf, new_scaler, new_rng,
+             finite) = self._accum_cache[key](
+                variables, grad_buf, scaler_state, rng, margs, mkwargs,
+                loss_args_flat,
+            )
         return (report, updated, new_vars, opt_state, new_buf, new_scaler,
                 new_rng, finite)
 

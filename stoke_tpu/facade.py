@@ -53,6 +53,8 @@ from stoke_tpu.engine import (
 from stoke_tpu.parallel.mesh import build_mesh, initialize_distributed
 from stoke_tpu.parallel.sharding import make_sharding_rules, place_global_tree
 from stoke_tpu.status import StokeStatus
+from stoke_tpu.telemetry import Telemetry
+from stoke_tpu.telemetry.collectors import xprof_span
 from stoke_tpu.utils.printing import unrolled_print
 from stoke_tpu.utils.trees import tree_count_params
 
@@ -356,11 +358,25 @@ class Stoke:
         self._materialize_warned = False
         self._tb_writer_obj = None
 
+        # ----- telemetry (ISSUE 1: unified pipeline — registry + sinks +
+        #       collectors; a None TelemetryConfig keeps the registry alive
+        #       for the wall-clock aliases but attaches no sinks) -----
+        self._telemetry = Telemetry(
+            st.telemetry_config, rank=jax.process_index()
+        )
+        # instance-scoped recompile attribution: this engine reports shape-
+        # driven recompiles to this run's tracker only (another facade's
+        # shape churn in the same process is not this run's problem)
+        self._engine._compile_tracker = self._telemetry.compile_tracker
+        self._last_grad_norm: Optional[float] = None
+
         # ----- wall-clock breakdown (reference wall_clock_breakdown,
         #       configs.py:540; host-side dispatch times — device work is
-        #       async, use profile_trace() for device timelines) -----
-        self._wall_clock: Dict[str, float] = {}
-        self._wall_clock_enabled = st.profiler_config.wall_clock_breakdown
+        #       async, use profile_trace() for device timelines).  Backed by
+        #       the telemetry registry; enabling telemetry implies it -----
+        self._wall_clock_enabled = (
+            st.profiler_config.wall_clock_breakdown or self._telemetry.enabled
+        )
 
         # ----- post-init status (reference stoke.py:245) -----
         world = self._mesh.size if self._mesh is not None else 1
@@ -507,7 +523,8 @@ class Stoke:
                 return jax.make_array_from_process_local_data(sh, x)
             return jax.device_put(x, sh)
 
-        return jax.tree_util.tree_map(_leaf, tree)
+        with xprof_span("stoke/place"):
+            return jax.tree_util.tree_map(_leaf, tree)
 
     # ------------------------------------------------------------------ #
     # mode toggles (torch module.train()/eval() equivalent)
@@ -672,6 +689,12 @@ class Stoke:
         """
         if self._grad_accum_counter < self._status_obj.grad_accum:
             return
+        will_record = self._telemetry_will_record()
+        if will_record:
+            self._sample_grad_norm()
+        t0 = time.perf_counter() if (
+            will_record and self._telemetry.will_sample_device()
+        ) else None
         (
             self._variables,
             new_opt,
@@ -685,6 +708,11 @@ class Stoke:
             self._scaler_state,
         )
         self._opt_commit(new_opt)
+        if t0 is not None:
+            # periodic true-device-time sample: one host sync per logging
+            # window (async dispatch hides device time otherwise)
+            jax.block_until_ready(self._variables)
+            self._telemetry.observe_device_step(time.perf_counter() - t0)
         if self._precision.scaled:
             self._skipped_steps = self._skipped_steps + (
                 1.0 - finite.astype(jnp.float32)
@@ -693,6 +721,7 @@ class Stoke:
         self._grad_accum_counter = 0
         self._reset_tracking_window()
         self._maybe_log_metrics()
+        self._maybe_emit_telemetry()
         self._maybe_auto_save()
 
     @_timed("train_step")
@@ -739,6 +768,10 @@ class Stoke:
             (i, l._path) for i, l in enumerate(flat) if is_deferred(l)
         )
         do_apply = self._grad_accum_counter + 1 >= self._status_obj.grad_accum
+        will_record = do_apply and self._telemetry_will_record()
+        t0 = time.perf_counter() if (
+            will_record and self._telemetry.will_sample_device()
+        ) else None
         (
             report,
             _updated,
@@ -765,6 +798,9 @@ class Stoke:
             self._opt_commit(new_opt)
         else:
             self._opt_state = new_opt
+        if t0 is not None:
+            jax.block_until_ready(self._variables)
+            self._telemetry.observe_device_step(time.perf_counter() - t0)
         self._pending = None
         self._backward_steps += 1
         self._update_loss_tracking(report)
@@ -777,6 +813,7 @@ class Stoke:
             self._grad_accum_counter = 0
             self._reset_tracking_window()
             self._maybe_log_metrics()
+            self._maybe_emit_telemetry()
             self._maybe_auto_save()
         else:
             self._grad_accum_counter += 1
@@ -805,8 +842,11 @@ class Stoke:
         return self._tb_writer_obj
 
     def log_scalar(self, tag: str, value, step: Optional[int] = None) -> None:
-        """Log a user scalar to TensorBoard (no-op without a
-        ``TensorboardConfig`` or off rank 0)."""
+        """Log a user scalar: lands in the telemetry registry (gauge
+        ``user/<tag>``, mirrored to sinks at the next cadence) AND — for
+        parity with the legacy contract — immediately in TensorBoard when a
+        ``TensorboardConfig`` is supplied on rank 0."""
+        self._telemetry.log_scalar(tag, float(value))
         w = self._tb_writer
         if w is not None:
             w.add_scalar(tag, float(value), step if step is not None
@@ -846,6 +886,81 @@ class Stoke:
             w.add_scalar("scaler/skipped_steps", self.skipped_optimizer_steps, step)
         w.add_scalar("counters/backward_steps", self._backward_steps, step)
         w.flush()
+
+    # ------------------------------------------------------------------ #
+    # telemetry step records (ISSUE 1: structured per-window events)
+    # ------------------------------------------------------------------ #
+
+    def _telemetry_will_record(self, window: int = 1) -> bool:
+        """True when the optimizer step(s) about to complete cross the
+        telemetry logging cadence (decides whether to pay for the optional
+        device-side samples: grad-norm reduction, block_until_ready)."""
+        t = self._telemetry
+        return t.enabled and self._crossed_boundary(
+            self._optimizer_steps + window,
+            t.config.log_every_n_steps,
+            window,
+        )
+
+    def _sample_grad_norm(self) -> None:
+        """Global norm of the accumulated gradient buffer (one device
+        reduction + fetch; only at the logging cadence and only when
+        ``TelemetryConfig.grad_norm``).  In fp16 single-loss mode the
+        buffer holds scale-multiplied grads (the apply unscales them,
+        engine._apply_core); the norm is divided by the current scale here
+        so the logged value is in true-gradient units.  Per-loss mode
+        (num_losses > 1) unscales into the buffer immediately, so no
+        adjustment applies."""
+        t = self._telemetry
+        if not (t.enabled and t.config.grad_norm):
+            return
+        try:
+            import optax
+
+            norm = float(jax.device_get(optax.global_norm(self._grad_buf)))
+            if (
+                self._precision.scaled
+                and self._status_obj.precision_config.num_losses == 1
+            ):
+                scale = float(jax.device_get(self._scaler_state["scale"]))
+                if scale > 0:
+                    norm /= scale
+            self._last_grad_norm = norm
+            t.registry.gauge("train/grad_norm").set(norm)
+        except Exception:
+            self._last_grad_norm = None
+
+    def _maybe_emit_telemetry(self, window: int = 1) -> None:
+        """Assemble + emit one structured step event at the telemetry
+        cadence (JSONL / Prometheus / TB sinks).  Device->host transfers
+        (EMA loss, loss scale) happen only here, never per micro-batch."""
+        t = self._telemetry
+        if not t.enabled or self._optimizer_steps == 0:
+            return
+        # samples/sec source of truth: one optimizer step consumes one
+        # (global) effective batch — counted per boundary, emitted at the
+        # cadence
+        t.add_samples((self._status_obj.effective_batch_size or 0) * window)
+        if not self._crossed_boundary(
+            self._optimizer_steps, t.config.log_every_n_steps, window
+        ):
+            return
+        scaled = self._precision.scaled
+        t.record_step(
+            self._optimizer_steps,
+            window_steps=window,
+            ema_loss=self.ema_loss,
+            step_loss=self.step_loss,
+            grad_norm=self._last_grad_norm,
+            loss_scale=self.loss_scale if scaled else None,
+            skipped_steps=self.skipped_optimizer_steps if scaled else 0.0,
+        )
+        self._last_grad_norm = None
+
+    def close_telemetry(self) -> None:
+        """Flush + close the telemetry sinks (idempotent; sinks are
+        line-buffered/atomic, so skipping this loses at most nothing)."""
+        self._telemetry.close()
 
     def _maybe_auto_save(self, window: int = 1) -> None:
         """Periodic checkpoint from the step path when
@@ -971,6 +1086,7 @@ class Stoke:
         self._optimizer_steps += 1
         self._reset_tracking_window()
         self._maybe_log_metrics()
+        self._maybe_emit_telemetry()
         self._maybe_auto_save()
         return reports
 
@@ -1151,6 +1267,7 @@ class Stoke:
             self._skipped_steps = self._skipped_steps + skipped
         self._optimizer_steps += n
         self._maybe_log_metrics(window=n)
+        self._maybe_emit_telemetry(window=n)
         self._maybe_auto_save(window=n)
         return reports
 
@@ -1315,35 +1432,35 @@ class Stoke:
     # ------------------------------------------------------------------ #
 
     def _clock(self, phase: str):
-        """Accumulating host-side timer for the wall-clock breakdown."""
+        """Accumulating host-side timer for the wall-clock breakdown —
+        a thin alias onto the telemetry registry (``facade/<phase>_s``
+        counters) plus a labeled xprof span."""
         import contextlib
 
         if not self._wall_clock_enabled:
             return contextlib.nullcontext()
+        return self._telemetry.phase(phase)
 
-        @contextlib.contextmanager
-        def _timer():
-            t0 = time.perf_counter()
-            try:
-                yield
-            finally:
-                self._wall_clock[phase] = self._wall_clock.get(phase, 0.0) + (
-                    time.perf_counter() - t0
-                )
-
-        return _timer()
+    @property
+    def telemetry(self) -> Telemetry:
+        """The run's telemetry pipeline (registry always live; sinks and
+        collectors attach when a ``TelemetryConfig`` is supplied)."""
+        return self._telemetry
 
     @property
     def wall_clock_breakdown(self) -> Dict[str, float]:
         """Cumulative host seconds per facade phase (enable via
-        ``ProfilerConfig(wall_clock_breakdown=True)``; reference
-        configs.py:540).  Host dispatch time only — device execution is
-        asynchronous; use :meth:`profile_trace` for device timelines."""
-        return dict(self._wall_clock)
+        ``ProfilerConfig(wall_clock_breakdown=True)`` or any
+        ``TelemetryConfig``; reference configs.py:540).  Host dispatch time
+        only — device execution is asynchronous; use :meth:`profile_trace`
+        for device timelines.  Registry-backed alias: the same numbers flow
+        into the telemetry sinks as ``facade/<phase>_s``."""
+        return self._telemetry.wall_clock_breakdown()
 
     def print_wall_clock_breakdown(self) -> None:
-        total = sum(self._wall_clock.values()) or 1.0
-        for phase, secs in sorted(self._wall_clock.items(), key=lambda kv: -kv[1]):
+        breakdown = self.wall_clock_breakdown
+        total = sum(breakdown.values()) or 1.0
+        for phase, secs in sorted(breakdown.items(), key=lambda kv: -kv[1]):
             self.print_on_devices(
                 f"wall_clock {phase}: {secs:.3f}s ({100 * secs / total:.1f}%)"
             )
@@ -1453,6 +1570,7 @@ class Stoke:
             dataset,
             batch_size=batch_size,
             place_fn=self._place_batch,
+            telemetry=self._telemetry if self._telemetry.enabled else None,
             **kwargs,
         )
 
@@ -1480,23 +1598,26 @@ class Stoke:
         vars_to_save = {
             k: v for k, v in self._variables.items() if k != "losses"
         }
-        return io_ops.save_checkpoint(
-            path=path,
-            name=name,
-            variables=vars_to_save,
-            opt_state=self._opt_materialize(),
-            scaler_state=self._scaler_state,
-            counters={
-                "backward_step": self._backward_steps,
-                "grad_accum_step": self._grad_accum_counter,
-                "optimizer_step": self._optimizer_steps,
-            },
-            status=self._status_obj.to_dict(),
-            extras=extras,
-            config=self._status_obj.checkpoint_config,
-            backward_step=self._backward_steps,
-            grad_buf=self._grad_buf if self._grad_accum_counter > 0 else None,
-        )
+        with xprof_span("stoke/io"):
+            return io_ops.save_checkpoint(
+                path=path,
+                name=name,
+                variables=vars_to_save,
+                opt_state=self._opt_materialize(),
+                scaler_state=self._scaler_state,
+                counters={
+                    "backward_step": self._backward_steps,
+                    "grad_accum_step": self._grad_accum_counter,
+                    "optimizer_step": self._optimizer_steps,
+                },
+                status=self._status_obj.to_dict(),
+                extras=extras,
+                config=self._status_obj.checkpoint_config,
+                backward_step=self._backward_steps,
+                grad_buf=(
+                    self._grad_buf if self._grad_accum_counter > 0 else None
+                ),
+            )
 
     @_timed("load")
     def load(
@@ -1525,16 +1646,17 @@ class Stoke:
         }
 
         def _load(like):
-            return io_ops.load_checkpoint(
-                path=path,
-                tag=tag,
-                variables_like=like,
-                opt_state_like=opt_like,
-                scaler_like=self._scaler_state,
-                config=self._status_obj.checkpoint_config,
-                name=name if tag is None else None,
-                grad_buf_like=self._grad_buf,
-            )
+            with xprof_span("stoke/io"):
+                return io_ops.load_checkpoint(
+                    path=path,
+                    tag=tag,
+                    variables_like=like,
+                    opt_state_like=opt_like,
+                    scaler_like=self._scaler_state,
+                    config=self._status_obj.checkpoint_config,
+                    name=name if tag is None else None,
+                    grad_buf_like=self._grad_buf,
+                )
 
         try:
             payload = _load(vars_like)

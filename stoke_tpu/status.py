@@ -44,6 +44,7 @@ from stoke_tpu.configs import (
     ProfilerConfig,
     SDDPConfig,
     ShardingOptions,
+    TelemetryConfig,
     TensorboardConfig,
     asdict_config,
 )
@@ -269,6 +270,39 @@ class StokeStatus:
                 )
             return False
 
+        def _probe_writable(target):
+            """Create ``target`` and prove a file can be written there.
+            Returns the OSError on failure, None on success.  NOTE:
+            validation intentionally creates the directory (so the first
+            mid-training log call can't fail on a missing path) and probes
+            actual writability with a throwaway file — makedirs succeeding
+            does not prove files can be written (permissions/quota can
+            still fail at first write)."""
+            import os
+            import uuid
+
+            try:
+                os.makedirs(target, exist_ok=True)
+                probe = os.path.join(
+                    target, f".stoke-write-probe-{uuid.uuid4().hex[:8]}"
+                )
+                with open(probe, "wb") as f:
+                    f.write(b"ok")
+                os.remove(probe)
+                return None
+            except OSError as e:
+                return e
+
+        def _rank0_only(message):
+            """Sink-path failures only matter on the writing process — a
+            worker on a read-only mount of a coordinator-owned log dir must
+            not kill the whole job."""
+            import jax
+
+            if jax.process_index() != 0:
+                return False
+            return message
+
         def _tensorboard_writable(s):
             # metrics use the in-repo native event writer
             # (utils/tb_writer.py) — no import to validate, but the output
@@ -278,35 +312,54 @@ class StokeStatus:
             if cfg is None:
                 return False
             import os
-            import uuid
 
-            # NOTE: validation intentionally creates the log directory (so
-            # the first mid-training log call can't fail on a missing path)
-            # and probes actual writability with a throwaway file — makedirs
-            # succeeding does not prove event files can be written
-            # (permissions/quota can still fail at first write)
-            target = os.path.join(cfg.output_path, cfg.job_name)
-            try:
-                os.makedirs(target, exist_ok=True)
-                probe = os.path.join(
-                    target, f".stoke-write-probe-{uuid.uuid4().hex[:8]}"
-                )
-                with open(probe, "wb") as f:
-                    f.write(b"ok")
-                os.remove(probe)
+            err = _probe_writable(os.path.join(cfg.output_path, cfg.job_name))
+            if err is None:
                 return False
-            except OSError as e:
-                # only process 0 ever writes event files (facade._tb_writer
-                # gates on is_rank_0) — a worker on a read-only mount of a
-                # coordinator-owned log dir must not kill the whole job
-                import jax
+            return _rank0_only(
+                f"TensorboardConfig output path "
+                f"{cfg.output_path!r}/{cfg.job_name!r} is not writable: {err}"
+            )
 
-                if jax.process_index() != 0:
-                    return False
+        def _telemetry_invalid(s):
+            # merged observability validation (TelemetryConfig +
+            # ProfilerConfig) — cadence/flag errors are structural (raise on
+            # every rank); sink-path errors are rank-0 only, same policy as
+            # the TB rule above
+            cfg = self._configs.get("TelemetryConfig")
+            if cfg is None:
+                return False
+            if cfg.log_every_n_steps < 1:
                 return (
-                    f"TensorboardConfig output path "
-                    f"{cfg.output_path!r}/{cfg.job_name!r} is not writable: {e}"
+                    f"TelemetryConfig.log_every_n_steps must be >= 1, got "
+                    f"{cfg.log_every_n_steps}"
                 )
+            if cfg.prometheus or cfg.tensorboard or cfg.jsonl:
+                err = _probe_writable(cfg.output_dir)
+                if err is not None:
+                    msg = (
+                        f"TelemetryConfig.output_dir {cfg.output_dir!r} is "
+                        f"not writable: {err}"
+                    )
+                    # all-rank JSONL writes on every process: the error is
+                    # fatal everywhere, not only on rank 0
+                    if cfg.jsonl and cfg.jsonl_all_ranks:
+                        return msg
+                    return _rank0_only(msg)
+            return False
+
+        def _profiler_invalid(s):
+            cfg = self._configs.get("ProfilerConfig")
+            if cfg is None or cfg.trace_dir is None:
+                return False
+            err = _probe_writable(cfg.trace_dir)
+            if err is None:
+                return False
+            # jax.profiler traces write from every process
+            return (
+                f"ProfilerConfig.trace_dir {cfg.trace_dir!r} is not "
+                f"writable: {err}"
+            )
 
         def _offload_cpu_no_fallback(s):
             for name in ("OffloadOptimizerConfig", "OffloadParamsConfig"):
@@ -420,6 +473,14 @@ class StokeStatus:
             (
                 _tensorboard_writable,
                 "TensorboardConfig output path is not writable",
+            ),
+            (
+                _telemetry_invalid,
+                "TelemetryConfig is invalid",
+            ),
+            (
+                _profiler_invalid,
+                "ProfilerConfig.trace_dir is not writable",
             ),
             (
                 _offload_cpu_no_fallback,
@@ -625,6 +686,13 @@ class StokeStatus:
         """None unless explicitly supplied (metrics logging is opt-in,
         reference configs.py:392-405)."""
         return self._configs.get("TensorboardConfig")
+
+    @property
+    def telemetry_config(self) -> Optional[TelemetryConfig]:
+        """None unless explicitly supplied (the unified telemetry pipeline
+        is opt-in; a None config keeps the facade's registry alive but
+        attaches no sinks/collectors)."""
+        return self._configs.get("TelemetryConfig")
 
     # ------------------------------------------------------------------ #
     # Serialization / display (reference status.py:629-654)

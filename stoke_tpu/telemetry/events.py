@@ -1,0 +1,201 @@
+"""Structured step-event schema: one JSONL record per logged step window.
+
+The JSONL sink writes these; dashboards/regression tooling read them back
+with :func:`read_step_events`.  The schema is versioned (``schema`` field)
+and validated on both ends (:func:`validate_step_event`) so a field drifting
+type silently is a test failure, not a 3am dashboard mystery.
+
+Field semantics (all times in seconds, all rates per second):
+
+- ``step``: optimizer step the window ENDS at.
+- ``window_steps``: optimizer steps covered by this record (a train_steps
+  segment emits ONE record covering the whole segment when any cadence
+  boundary was crossed inside it, window > 1).
+- ``host_dispatch_s``: host wall-clock spent inside facade phases since the
+  previous record (dispatch cost, NOT device time — device work is async).
+- ``device_step_s``: sampled device time of one optimizer step, measured by
+  bracketing a dispatch with ``block_until_ready`` at the logging cadence;
+  ``null`` when sampling is disabled or no sample landed in the window.
+- ``loader_wait_s``: host time the training loop spent blocked on the data
+  loader since the previous record (starvation indicator — compare against
+  ``host_dispatch_s``).
+- ``samples_per_s`` / ``tokens_per_s``: window rates from the data-layer
+  counters (tokens only when a sequence pipeline reports them).
+- ``grad_norm``: global gradient norm of the accumulated buffer at the
+  boundary (only when ``TelemetryConfig.grad_norm`` — costs one reduction).
+- ``loss_scale`` / ``loss_scale_events``: fp16 dynamic scale and the count
+  of backoff/growth transitions observed so far (``null``/0 outside fp16).
+- ``compiles_total`` / ``recompiles`` / ``compile_time_s``: XLA compile
+  activity (recompiles = compiles beyond each jit entry's first — the
+  silent TPU perf killer this subsystem exists to surface).
+- ``hbm_*``: device-0 memory stats high-watermark (``null`` on backends
+  that report none, e.g. CPU).
+"""
+
+from __future__ import annotations
+
+import json
+import numbers
+from typing import Any, Dict, List, Optional
+
+#: schema identifier embedded in every record
+STEP_EVENT_SCHEMA = "stoke_tpu.telemetry.step/v1"
+
+#: field -> (required, allowed python kinds); "number" accepts int/float,
+#: "nullable_number" also accepts None
+STEP_EVENT_FIELDS: Dict[str, tuple] = {
+    "schema": (True, "string"),
+    "ts": (True, "number"),
+    "step": (True, "int"),
+    "rank": (True, "int"),
+    "window_steps": (True, "int"),
+    "host_dispatch_s": (True, "number"),
+    "device_step_s": (False, "nullable_number"),
+    "loader_wait_s": (True, "number"),
+    "samples_per_s": (False, "nullable_number"),
+    "tokens_per_s": (False, "nullable_number"),
+    "samples_total": (True, "number"),
+    "ema_loss": (False, "nullable_number"),
+    "step_loss": (False, "nullable_number"),
+    "grad_norm": (False, "nullable_number"),
+    "loss_scale": (False, "nullable_number_or_list"),
+    "loss_scale_events": (False, "int"),
+    "skipped_steps": (False, "number"),
+    "compiles_total": (True, "int"),
+    "recompiles": (True, "int"),
+    "compile_time_s": (True, "number"),
+    "hbm_bytes_in_use": (False, "nullable_number"),
+    "hbm_peak_bytes": (False, "nullable_number"),
+    "hbm_bytes_limit": (False, "nullable_number"),
+}
+
+
+def _kind_ok(value: Any, kind: str) -> bool:
+    if kind == "string":
+        return isinstance(value, str)
+    if kind == "int":
+        return isinstance(value, numbers.Integral) and not isinstance(value, bool)
+    if kind == "number":
+        return isinstance(value, numbers.Real) and not isinstance(value, bool)
+    if kind == "nullable_number":
+        return value is None or _kind_ok(value, "number")
+    if kind == "nullable_number_or_list":
+        if value is None or _kind_ok(value, "number"):
+            return True
+        return isinstance(value, list) and all(
+            _kind_ok(v, "number") for v in value
+        )
+    raise AssertionError(f"unknown schema kind {kind!r}")
+
+
+def validate_step_event(record: Dict[str, Any]) -> None:
+    """Raise ``ValueError`` when ``record`` violates the v1 step schema
+    (missing required field, wrong type, unknown field, wrong version)."""
+    if not isinstance(record, dict):
+        raise ValueError(f"step event must be a dict, got {type(record).__name__}")
+    if record.get("schema") != STEP_EVENT_SCHEMA:
+        raise ValueError(
+            f"unknown step-event schema {record.get('schema')!r} "
+            f"(expected {STEP_EVENT_SCHEMA!r})"
+        )
+    for field, (required, kind) in STEP_EVENT_FIELDS.items():
+        if field not in record:
+            if required:
+                raise ValueError(f"step event missing required field {field!r}")
+            continue
+        if not _kind_ok(record[field], kind):
+            raise ValueError(
+                f"step event field {field!r} has invalid value "
+                f"{record[field]!r} (expected {kind})"
+            )
+    unknown = set(record) - set(STEP_EVENT_FIELDS)
+    if unknown:
+        raise ValueError(f"step event has unknown fields {sorted(unknown)}")
+
+
+def read_step_events(path: str, validate: bool = True) -> List[Dict[str, Any]]:
+    """Load a JSONL step-event file back into records (the consumer half of
+    the schema contract; round-tripped in tests/test_telemetry.py)."""
+    out = []
+    with open(path) as f:
+        for line_no, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                raise ValueError(f"{path}:{line_no}: invalid JSON ({e})") from e
+            if validate:
+                try:
+                    validate_step_event(rec)
+                except ValueError as e:
+                    raise ValueError(f"{path}:{line_no}: {e}") from e
+            out.append(rec)
+    return out
+
+
+def _round(value: Optional[float], digits: int = 6):
+    if value is None:
+        return None
+    return round(float(value), digits)
+
+
+def build_step_event(
+    *,
+    ts: float,
+    step: int,
+    rank: int,
+    window_steps: int,
+    host_dispatch_s: float,
+    loader_wait_s: float,
+    samples_total: float,
+    compiles_total: int,
+    recompiles: int,
+    compile_time_s: float,
+    device_step_s: Optional[float] = None,
+    samples_per_s: Optional[float] = None,
+    tokens_per_s: Optional[float] = None,
+    ema_loss: Optional[float] = None,
+    step_loss: Optional[float] = None,
+    grad_norm: Optional[float] = None,
+    loss_scale=None,
+    loss_scale_events: int = 0,
+    skipped_steps: float = 0.0,
+    hbm_bytes_in_use: Optional[int] = None,
+    hbm_peak_bytes: Optional[int] = None,
+    hbm_bytes_limit: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Assemble + validate a v1 step event (single construction point so the
+    schema cannot drift from the writer)."""
+    record = {
+        "schema": STEP_EVENT_SCHEMA,
+        "ts": float(ts),
+        "step": int(step),
+        "rank": int(rank),
+        "window_steps": int(window_steps),
+        "host_dispatch_s": _round(host_dispatch_s),
+        "device_step_s": _round(device_step_s),
+        "loader_wait_s": _round(loader_wait_s),
+        "samples_per_s": _round(samples_per_s, 3),
+        "tokens_per_s": _round(tokens_per_s, 3),
+        "samples_total": float(samples_total),
+        "ema_loss": _round(ema_loss),
+        "step_loss": _round(step_loss),
+        "grad_norm": _round(grad_norm),
+        "loss_scale": (
+            [float(v) for v in loss_scale]
+            if isinstance(loss_scale, (list, tuple))
+            else (None if loss_scale is None else float(loss_scale))
+        ),
+        "loss_scale_events": int(loss_scale_events),
+        "skipped_steps": float(skipped_steps),
+        "compiles_total": int(compiles_total),
+        "recompiles": int(recompiles),
+        "compile_time_s": _round(compile_time_s),
+        "hbm_bytes_in_use": hbm_bytes_in_use,
+        "hbm_peak_bytes": hbm_peak_bytes,
+        "hbm_bytes_limit": hbm_bytes_limit,
+    }
+    validate_step_event(record)
+    return record
