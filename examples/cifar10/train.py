@@ -116,6 +116,17 @@ def build_stoke(cfg: dict) -> Stoke:
             TelemetryConfig(**spec) if isinstance(spec, dict)
             else TelemetryConfig()
         )
+    if cfg.get("comm"):
+        # comm: {dtype: int8, bucket_mb: 25, error_feedback: true} — or
+        # `comm: int8` shorthand.  Quantized gradient collectives with
+        # error feedback (docs/sharding.md "Quantized gradient collectives")
+        from stoke_tpu import CommConfig
+
+        spec = cfg["comm"]
+        configs.append(
+            CommConfig(**spec) if isinstance(spec, dict)
+            else CommConfig(dtype=str(spec))
+        )
     return Stoke(
         model=model,
         optimizer=StokeOptimizer(

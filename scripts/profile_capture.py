@@ -167,7 +167,7 @@ def main():
                 fn = stoke._engine._build_fused(treedef, dinfo, True)
                 compiled = fn.lower(
                     stoke._variables, stoke._opt_state, stoke._grad_buf,
-                    stoke._scaler_state, stoke._rng,
+                    stoke._scaler_state, stoke._comm_state, stoke._rng,
                     stoke._place_batch((x1,)), {}, arrays,
                 ).compile()
                 text = compiled.as_text()

@@ -87,6 +87,13 @@ FOLLOWUP_ARMS = (
     # scheduler also reorders HBM prefetch against compute — worth one arm
     ("bench.py",
      ["--xla-flags=--xla_tpu_enable_latency_hiding_scheduler=true"]),
+    # gradient-transport A/B (ISSUE 2): int8 quantized gradient exchange
+    # through the same bench path.  On one chip the mesh is 1-wide, so
+    # this measures the quantize/dequantize + error-feedback overhead the
+    # transport adds (the on-pod win is bytes-on-wire, covered by the
+    # 8-device telemetry tests offline); a distinct configuration for the
+    # ledger, never substituted for the headline
+    ("bench.py", ["--comm-dtype=int8"]),
 )
 
 

@@ -1,0 +1,158 @@
+"""Classifying tunnel probe: turn "probe failed/timed out" into data.
+
+VERDICT r5 #5: three rounds of 10-minute watcher probes recorded only
+"probe failed/timed out" — no distinction between TCP-unreachable, a
+TCP-open-but-PJRT-handshake hang, or a backend-init error.  This probe
+records an error CLASS per attempt so the outage distribution can be
+summarized (BENCH_NOTES.md wedge characterization):
+
+  classes:
+    ok                   accelerator backend came up
+    cpu-only             jax initialized but saw only the CPU backend
+    tcp-refused          relay endpoint actively refused the connection
+    tcp-timeout          relay endpoint did not complete the TCP handshake
+    tcp-ok-probe-timeout TCP connects but the PJRT client hangs — the
+                         single-client-relay wedge signature
+    probe-timeout        PJRT probe hung and no endpoint is known to
+                         separate relay-down from backend-down
+    pjrt-error:<text>    backend init failed fast with an error
+    import-error:<text>  jax import itself failed
+
+The bare TCP liveness check needs no JAX (separates relay-down from
+backend-down); the endpoint is taken from ``STOKE_TUNNEL_ENDPOINT``
+(host:port) when the environment exports one — unset, the TCP half is
+skipped and recorded as ``endpoint-unknown``.
+
+Every attempt appends one JSON line to ``--log`` (default
+/tmp/tunnel_probe_log.jsonl).  ``--summarize`` prints the class
+distribution of the accumulated log — the multi-round evidence VERDICT
+asked for.  Exit code: 0 when the accelerator is ALIVE, 1 otherwise
+(drop-in for the watcher's inline probe).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+DEFAULT_LOG = "/tmp/tunnel_probe_log.jsonl"
+PROBE_TIMEOUT = 120
+TCP_TIMEOUT = 10
+
+
+def tcp_liveness(endpoint: str | None) -> str:
+    """Bare no-JAX TCP check of the relay endpoint."""
+    if not endpoint or ":" not in endpoint:
+        return "endpoint-unknown"
+    host, _, port = endpoint.rpartition(":")
+    try:
+        with socket.create_connection((host, int(port)), timeout=TCP_TIMEOUT):
+            return "tcp-ok"
+    except ConnectionRefusedError:
+        return "tcp-refused"
+    except (socket.timeout, TimeoutError):
+        return "tcp-timeout"
+    except OSError as e:
+        return f"tcp-error:{type(e).__name__}"
+
+
+def jax_probe(timeout: int = PROBE_TIMEOUT) -> tuple[str, str]:
+    """PJRT bring-up in a subprocess (a wedged tunnel hangs the import, so
+    the parent must never import jax).  Returns (class, detail)."""
+    code = (
+        "import jax\n"
+        "ds = jax.devices()\n"
+        "print('BACKEND', jax.default_backend())\n"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return "probe-timeout", f"no PJRT response in {timeout}s"
+    if out.returncode == 0:
+        lines = (out.stdout or "").strip().splitlines()
+        backend = lines[-1].split()[-1] if lines else ""
+        if backend == "cpu":
+            return "cpu-only", "jax up, CPU backend only"
+        return "ok", f"backend={backend}"
+    err = (out.stderr or "").strip().splitlines()
+    detail = err[-1][:200] if err else "probe failed with no stderr"
+    if "ImportError" in detail or "ModuleNotFoundError" in detail:
+        return f"import-error:{detail[:80]}", detail
+    return f"pjrt-error:{detail[:80]}", detail
+
+
+def classify(endpoint: str | None) -> dict:
+    tcp = tcp_liveness(endpoint)
+    if tcp in ("tcp-refused", "tcp-timeout") or tcp.startswith("tcp-error"):
+        # relay unreachable at the socket level: no point paying the
+        # 120s PJRT timeout — the class IS the TCP failure
+        return {"class": tcp, "tcp": tcp, "detail": "relay socket down"}
+    cls, detail = jax_probe()
+    if cls == "probe-timeout" and tcp == "tcp-ok":
+        # the wedge signature: socket accepts, PJRT never answers
+        cls = "tcp-ok-probe-timeout"
+    return {"class": cls, "tcp": tcp, "detail": detail}
+
+
+def summarize(log_path: str) -> dict:
+    counts: dict = {}
+    first = last = None
+    try:
+        with open(log_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                # aggregate by the class PREFIX: pjrt-error/import-error
+                # classes embed truncated error text (kept per-attempt in
+                # the log), which would fragment the distribution into
+                # singleton buckets if counted verbatim
+                cls = rec.get("class", "?").split(":", 1)[0]
+                counts[cls] = counts.get(cls, 0) + 1
+                first = first or rec.get("ts")
+                last = rec.get("ts")
+    except OSError:
+        pass
+    return {"probe_summary": counts, "attempts": sum(counts.values()),
+            "first_ts": first, "last_ts": last}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--endpoint",
+                    default=os.environ.get("STOKE_TUNNEL_ENDPOINT"),
+                    help="relay host:port for the bare TCP check "
+                    "(default: $STOKE_TUNNEL_ENDPOINT)")
+    ap.add_argument("--log", default=DEFAULT_LOG,
+                    help="JSONL attempt log (appended)")
+    ap.add_argument("--summarize", action="store_true",
+                    help="print the class distribution of the log and exit")
+    args = ap.parse_args()
+    if args.summarize:
+        print(json.dumps(summarize(args.log)))
+        return 0
+    rec = classify(args.endpoint)
+    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    print(json.dumps(rec), flush=True)
+    try:
+        with open(args.log, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+    return 0 if rec["class"] == "ok" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,7 +26,11 @@ while true; do
     rm -f /tmp/tpu_in_use
   fi
   echo "$(date -u +%H:%M:%S) probing tunnel..."
-  if timeout 125 python -c "import jax; assert jax.devices()[0].platform != 'cpu', jax.devices(); print('ALIVE', jax.devices())"; then
+  # classifying probe (scripts/tunnel_probe.py): records the error CLASS
+  # per attempt (tcp-refused / tcp-ok-probe-timeout / pjrt-error / ...)
+  # into /tmp/tunnel_probe_log.jsonl so the outage distribution is data,
+  # not "timed out" (VERDICT r5 #5); exit 0 = accelerator ALIVE
+  if timeout 150 python scripts/tunnel_probe.py; then
     echo "$(date -u +%H:%M:%S) tunnel ALIVE -> launching tpu_session"
     python scripts/tpu_session.py >> "$LOG" 2>&1
     rc=$?
@@ -38,7 +42,8 @@ while true; do
     # session failed (likely mid-run wedge): back off longer, then resume probing
     sleep 1200
   else
-    echo "$(date -u +%H:%M:%S) probe failed/timed out; retry in 10 min"
+    echo "$(date -u +%H:%M:%S) probe failed; class distribution so far:"
+    python scripts/tunnel_probe.py --summarize || true
     sleep 600
   fi
 done

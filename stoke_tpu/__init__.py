@@ -12,6 +12,7 @@ from stoke_tpu.configs import (
     CheckpointFormat,
     ClipGradConfig,
     ClipGradNormConfig,
+    CommConfig,
     DataParallelConfig,
     DeviceOptions,
     DistributedInitConfig,
@@ -75,6 +76,7 @@ __all__ = [
     "PrecisionConfig",
     "ClipGradConfig",
     "ClipGradNormConfig",
+    "CommConfig",
     "DataParallelConfig",
     "MeshConfig",
     "DistributedInitConfig",

@@ -236,6 +236,71 @@ class DataParallelConfig:
 
 
 @dataclass
+class CommConfig:
+    """Gradient-transport layer: quantized gradient synchronization with
+    error feedback and bucketed flattening (ISSUE 2 tentpole).
+
+    No reference equivalent (the reference's DDP gradient compression hooks
+    were never surfaced; its gradients always sync fp32).  TPU-native
+    motivation: the DP/ZeRO path syncs gradients through compiler-inserted
+    collectives, so gradient bytes-on-wire are the scaling tax of every
+    multi-chip config; EQuARX (arXiv:2506.17615) shows a quantized
+    all-reduce inside XLA recovers most of that bandwidth at negligible
+    quality cost, and it composes with cross-replica weight-update sharding
+    (arXiv:2004.13336 — the ``oss`` tier here).
+
+    The transport runs ONCE per optimizer step at the apply boundary (the
+    accumulation window commits locally; micro-steps never quantize):
+    gradient leaves are flattened into ``bucket_mb`` buckets so many small
+    conv/BN grads ride one collective, each bucket is exchanged as
+    reduce-scatter → per-chunk-scaled (stochastic-rounding) quantize →
+    all-gather over the mesh data axis, and the per-leaf quantization
+    residual is carried in engine state and re-injected next step
+    (error feedback — preserves convergence, arXiv:1901.09847 lineage).
+
+    Simulation-fidelity note: at the JAX level the pre-reduction partial
+    gradients live inside GSPMD, so the reduce-scatter leg quantizes the
+    logically-reduced value (one quantization error) where a compiler-level
+    implementation (EQuARX) quantizes each partial; the wire format, byte
+    counts, and error-feedback machinery are identical, and the error
+    feedback absorbs either noise source.  ``dtype="fp32"`` is an exact
+    pass-through (bit-identical to running without a CommConfig).
+
+    Attributes:
+        dtype: wire dtype of the gradient exchange — "fp32" (pass-through),
+            "bf16" (2 bytes/elem, deterministic cast), or "int8"
+            (1 byte/elem + one f32 scale per ``chunk_elems`` chunk,
+            ~3.9x fewer bytes-on-wire than fp32).
+        bucket_mb: flat-bucket size in MB of fp32 gradient payload; leaves
+            are concatenated in tree order until a bucket fills (one
+            collective per bucket instead of one per leaf).
+        error_feedback: carry the per-leaf quantization residual in engine
+            state and add it to the next step's gradients before quantizing
+            (int8/bf16 only; structurally absent for fp32 pass-through).
+        strategy: "rs_ag" (reduce-scatter then quantized all-gather — the
+            weight-update-sharding-compatible schedule) or "all_reduce"
+            (single quantize → sum exchange → dequantize).
+        chunk_elems: elements sharing one f32 scale in int8 mode (scale
+            overhead = 4/chunk_elems bytes/elem; 512 → ~0.8%).
+        stochastic_rounding: unbiased stochastic rounding for int8
+            (deterministic round-to-nearest when False — useful for tests).
+    """
+
+    dtype: str = "fp32"
+    bucket_mb: float = 25.0
+    error_feedback: bool = True
+    strategy: str = "rs_ag"
+    chunk_elems: int = 512
+    stochastic_rounding: bool = True
+
+
+#: wire dtypes the transport understands (validated by the status layer)
+COMM_DTYPES: Tuple[str, ...] = ("fp32", "bf16", "int8")
+#: collective schedules the transport understands
+COMM_STRATEGIES: Tuple[str, ...] = ("rs_ag", "all_reduce")
+
+
+@dataclass
 class MeshConfig:
     """Logical device mesh specification.
 
@@ -629,6 +694,7 @@ ALL_CONFIG_CLASSES: Tuple[type, ...] = (
     PrecisionConfig,
     ClipGradConfig,
     ClipGradNormConfig,
+    CommConfig,
     DataParallelConfig,
     MeshConfig,
     DistributedInitConfig,

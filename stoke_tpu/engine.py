@@ -48,6 +48,7 @@ from stoke_tpu.configs import (
     PrecisionOptions,
     StokeOptimizer,
 )
+from stoke_tpu.parallel.collectives import GradTransport
 from stoke_tpu.parallel.sharding import ShardingRules, place_global_tree
 from stoke_tpu.telemetry.collectors import xprof_span
 from stoke_tpu.utils.trees import tree_cast, tree_finite, tree_zeros_like
@@ -399,6 +400,7 @@ class StepEngine:
         offload_params: Optional[Any] = None,
         loss_weights: Optional[Any] = None,
         aux_loss_weight: float = 0.01,
+        comm: Optional[Any] = None,
     ):
         self.adapter = adapter
         self.loss_fn = loss_fn
@@ -413,6 +415,17 @@ class StepEngine:
         self.remat = remat
         self.offload_optimizer = offload_optimizer
         self.offload_params = offload_params
+        # gradient-transport layer (ISSUE 2): quantized collectives with
+        # error feedback, applied ONCE per optimizer step inside the apply
+        # core.  A None comm config (or dtype="fp32") makes the transport a
+        # structural pass-through: the apply program is byte-for-byte the
+        # same as before the layer existed.
+        self.comm = comm
+        self.transport = GradTransport(
+            comm,
+            rules.mesh if rules is not None else None,
+            rules.axis_name if rules is not None else "data",
+        )
         self._accum_cache: Dict[Any, Callable] = {}
         self._fwd_cache: Dict[Any, Callable] = {}
         self._loss_cache: Dict[Any, Callable] = {}
@@ -564,6 +577,30 @@ class StepEngine:
         if self._grad_shardings is not None:
             zeros = place_global_tree(zeros, self._grad_shardings)
         return zeros
+
+    def init_comm_state(self, variables):
+        """Carried gradient-transport state (stochastic-rounding rng +
+        error-feedback residual, placed like the gradient buffer).  An
+        empty dict when the transport is inactive — threading it through
+        the compiled steps is then structurally free."""
+        state = self.transport.init_state(variables["params"])
+        if not state:
+            return state
+        if self._grad_shardings is not None:
+            return place_global_tree(state, self._comm_state_shardings())
+        return state
+
+    def _comm_state_shardings(self):
+        """out_shardings tree matching the comm state structure ({} when
+        the transport is inactive)."""
+        if self._grad_shardings is None or not self.transport.active:
+            return {}
+        return self.transport.state_shardings(self._grad_shardings, self._repl)
+
+    def comm_bytes_per_step(self, variables) -> Optional[Dict[str, int]]:
+        """Analytic per-device gradient bytes-on-wire of one optimizer
+        step (telemetry: pre-quantization vs wire-format bytes)."""
+        return self.transport.bytes_per_step(variables["params"])
 
     def init_opt_state(self, variables):
         """Optimizer-state init, created directly onto the tier's placement
@@ -901,6 +938,7 @@ class StepEngine:
         opt_state,
         grad_buf,
         scaler_state,
+        comm_state,
         rng,
         margs_stacked: tuple,
         mkwargs_stacked: dict,
@@ -916,7 +954,7 @@ class StepEngine:
 
         Stacked args carry the micro dimension on axis 0 (leaf shape
         [k, micro_batch, ...]).  Returns (reports_stacked, variables,
-        opt_state, grad_buf, scaler_state, rng, finite).
+        opt_state, grad_buf, scaler_state, comm_state, rng, finite).
         """
         key = (
             "window",
@@ -931,8 +969,8 @@ class StepEngine:
         )
         with xprof_span("stoke/dispatch"):
             return self._accum_cache[key](
-                variables, opt_state, grad_buf, scaler_state, rng,
-                margs_stacked, mkwargs_stacked, loss_args_flat_stacked,
+                variables, opt_state, grad_buf, scaler_state, comm_state,
+                rng, margs_stacked, mkwargs_stacked, loss_args_flat_stacked,
             )
 
     def _window_core(self, loss_treedef, deferred_info):
@@ -943,8 +981,8 @@ class StepEngine:
         accum = self._accum_core(loss_treedef, deferred_info, training=True)
         apply_core = self._apply_core()
 
-        def _window(variables, opt_state, grad_buf, scaler_state, rng,
-                    margs_s, mkwargs_s, larr_s):
+        def _window(variables, opt_state, grad_buf, scaler_state, comm_state,
+                    rng, margs_s, mkwargs_s, larr_s):
             # host-offloaded params → HBM ONCE, outside the scan (the accum
             # core's own transfer is then a no-op on already-device params)
             variables = self._vars_to_compute(variables)
@@ -966,11 +1004,11 @@ class StepEngine:
                 (margs_s, mkwargs_s, larr_s),
             )
             merged = {"params": params, **nonparam_f}
-            new_vars, new_opt, zero_buf, new_scaler, finite = apply_core(
-                merged, opt_state, new_buf, scaler_mid
+            new_vars, new_opt, zero_buf, new_scaler, new_comm, finite = (
+                apply_core(merged, opt_state, new_buf, scaler_mid, comm_state)
             )
             return (reports, new_vars, new_opt, zero_buf, new_scaler,
-                    new_rng, finite)
+                    new_comm, new_rng, finite)
 
         return _window
 
@@ -985,11 +1023,14 @@ class StepEngine:
                 self._opt_shardings,
                 self._grad_shardings,
                 self._scaler_shardings(),
+                self._comm_state_shardings(),
                 repl,
                 repl,
             )
-            return jax.jit(_window, out_shardings=out_sh, donate_argnums=(0, 1, 2))
-        return jax.jit(_window, donate_argnums=(0, 1, 2))
+            return jax.jit(
+                _window, out_shardings=out_sh, donate_argnums=(0, 1, 2, 4)
+            )
+        return jax.jit(_window, donate_argnums=(0, 1, 2, 4))
 
     # ----------------------- multi-step scan ---------------------------- #
 
@@ -999,6 +1040,7 @@ class StepEngine:
         opt_state,
         grad_buf,
         scaler_state,
+        comm_state,
         rng,
         margs_stacked: tuple,
         mkwargs_stacked: dict,
@@ -1016,7 +1058,7 @@ class StepEngine:
 
         Stacked args carry [n_steps, grad_accum, micro_batch, ...] leaves.
         Returns (reports [n, k, ...], variables, opt_state, grad_buf,
-        scaler_state, rng, n_nonfinite_steps).
+        scaler_state, comm_state, rng, n_nonfinite_steps).
         """
         key = (
             "multi",
@@ -1031,44 +1073,46 @@ class StepEngine:
         )
         with xprof_span("stoke/dispatch"):
             return self._accum_cache[key](
-                variables, opt_state, grad_buf, scaler_state, rng,
-                margs_stacked, mkwargs_stacked, loss_args_flat_stacked,
+                variables, opt_state, grad_buf, scaler_state, comm_state,
+                rng, margs_stacked, mkwargs_stacked, loss_args_flat_stacked,
             )
 
     def _build_multi(self, loss_treedef, deferred_info):
         window = self._window_core(loss_treedef, deferred_info)
 
-        def _multi(variables, opt_state, grad_buf, scaler_state, rng,
-                   margs_s, mkwargs_s, larr_s):
+        def _multi(variables, opt_state, grad_buf, scaler_state, comm_state,
+                   rng, margs_s, mkwargs_s, larr_s):
             # offloaded state → HBM ONCE, outside both scans (the cores'
             # internal transfers are no-ops on already-device state)
             variables = self._vars_to_compute(variables)
             opt_state = self._opt_to_compute(opt_state)
 
             def step_body(carry, xs):
-                variables, opt_state, buf, scaler_state, rng, skipped = carry
+                (variables, opt_state, buf, scaler_state, comm_state, rng,
+                 skipped) = carry
                 margs, mkwargs, larr = xs  # [k, ...] micro-batches
-                (reports, new_vars, new_opt, zero_buf, new_scaler, new_rng,
-                 finite) = window(
-                    variables, opt_state, buf, scaler_state, rng,
+                (reports, new_vars, new_opt, zero_buf, new_scaler, new_comm,
+                 new_rng, finite) = window(
+                    variables, opt_state, buf, scaler_state, comm_state, rng,
                     margs, mkwargs, larr,
                 )
                 skipped = skipped + (1.0 - finite.astype(jnp.float32))
                 return (
-                    (new_vars, new_opt, zero_buf, new_scaler, new_rng,
-                     skipped),
+                    (new_vars, new_opt, zero_buf, new_scaler, new_comm,
+                     new_rng, skipped),
                     reports,
                 )
 
-            (vars_f, opt_f, buf_f, scaler_f, rng_f, skipped), reports = (
+            (vars_f, opt_f, buf_f, scaler_f, comm_f, rng_f, skipped), reports = (
                 jax.lax.scan(
                     step_body,
-                    (variables, opt_state, grad_buf, scaler_state, rng,
-                     jnp.float32(0.0)),
+                    (variables, opt_state, grad_buf, scaler_state, comm_state,
+                     rng, jnp.float32(0.0)),
                     (margs_s, mkwargs_s, larr_s),
                 )
             )
-            return reports, vars_f, opt_f, buf_f, scaler_f, rng_f, skipped
+            return (reports, vars_f, opt_f, buf_f, scaler_f, comm_f, rng_f,
+                    skipped)
 
         if self.rules is not None:
             repl = self._repl
@@ -1078,22 +1122,28 @@ class StepEngine:
                 self._opt_shardings,
                 self._grad_shardings,
                 self._scaler_shardings(),
+                self._comm_state_shardings(),
                 repl,  # rng
                 repl,  # skipped count
             )
-            return jax.jit(_multi, out_shardings=out_sh, donate_argnums=(0, 1, 2))
-        return jax.jit(_multi, donate_argnums=(0, 1, 2))
+            return jax.jit(
+                _multi, out_shardings=out_sh, donate_argnums=(0, 1, 2, 4)
+            )
+        return jax.jit(_multi, donate_argnums=(0, 1, 2, 4))
 
     # ---------------------------- apply step --------------------------- #
 
-    def apply_step(self, variables, opt_state, grad_buf, scaler_state):
-        """Compiled optimizer application: unscale → finite-check → clip →
-        update → zero buffer → scaler update (reference step() path,
-        stoke.py:990-1040 + fp16.py:788-806)."""
+    def apply_step(self, variables, opt_state, grad_buf, scaler_state,
+                   comm_state):
+        """Compiled optimizer application: unscale → gradient transport →
+        finite-check → clip → update → zero buffer → scaler update
+        (reference step() path, stoke.py:990-1040 + fp16.py:788-806)."""
         if self._apply_fn is None:
             self._apply_fn = self._build_apply()
         with xprof_span("stoke/step"):
-            return self._apply_fn(variables, opt_state, grad_buf, scaler_state)
+            return self._apply_fn(
+                variables, opt_state, grad_buf, scaler_state, comm_state
+            )
 
     def _apply_core(self):
         """Unjitted apply core, shared by step() and the fused train_step."""
@@ -1101,8 +1151,9 @@ class StepEngine:
         cfg = self.precision_config
         grad_clip = self.grad_clip
         optimizer = self.optimizer
+        transport = self.transport
 
-        def _apply(variables, opt_state, grad_buf, scaler_state):
+        def _apply(variables, opt_state, grad_buf, scaler_state, comm_state):
             # host-offloaded state → HBM for the (bandwidth-bound) update;
             # out_shardings write new params / opt state back to host
             variables = self._vars_to_compute(variables)
@@ -1118,6 +1169,12 @@ class StepEngine:
                     1.0 / scaler_state["scale"] if scaled else jnp.float32(1.0)
                 )
             grads = jax.tree_util.tree_map(lambda g: g * inv, grad_buf)
+            # gradient transport (ISSUE 2): quantized exchange + error
+            # feedback on the UNSCALED, whole-window gradients — once per
+            # optimizer step, never per micro-step.  Inactive transport
+            # (no CommConfig / dtype="fp32") returns grads and the empty
+            # state untouched: the compiled program is unchanged.
+            grads, new_comm = transport.apply(grads, comm_state)
             finite = tree_finite(grads) if scaled else jnp.asarray(True)
             if per_loss:
                 # any loss overflowing anywhere in the window skips the step
@@ -1155,7 +1212,7 @@ class StepEngine:
                 new_scaler = scaler_state
             new_vars = {**variables, "params": new_params}
             zero_buf = tree_zeros_like(grad_buf)
-            return new_vars, new_opt, zero_buf, new_scaler, finite
+            return new_vars, new_opt, zero_buf, new_scaler, new_comm, finite
 
         return _apply
 
@@ -1167,10 +1224,13 @@ class StepEngine:
                 self._opt_shardings,
                 self._grad_shardings,
                 self._scaler_shardings(),
+                self._comm_state_shardings(),
                 self._repl,
             )
-            return jax.jit(_apply, out_shardings=out_sh, donate_argnums=(0, 1, 2))
-        return jax.jit(_apply, donate_argnums=(0, 1, 2))
+            return jax.jit(
+                _apply, out_shardings=out_sh, donate_argnums=(0, 1, 2, 4)
+            )
+        return jax.jit(_apply, donate_argnums=(0, 1, 2, 4))
 
     # ------------------------ fused train step -------------------------- #
 
@@ -1180,6 +1240,7 @@ class StepEngine:
         opt_state,
         grad_buf,
         scaler_state,
+        comm_state,
         rng,
         margs: tuple,
         mkwargs: dict,
@@ -1198,7 +1259,7 @@ class StepEngine:
         compiles the same math split across two dispatches.
 
         Returns (report, updated_nonparam_vars, variables, opt_state,
-        grad_buf, scaler_state, rng, finite).
+        grad_buf, scaler_state, comm_state, rng, finite).
         """
         key = (
             "fused",
@@ -1215,12 +1276,13 @@ class StepEngine:
         if do_apply:
             with xprof_span("stoke/dispatch"):
                 return self._accum_cache[key](
-                    variables, opt_state, grad_buf, scaler_state, rng, margs,
-                    mkwargs, loss_args_flat,
+                    variables, opt_state, grad_buf, scaler_state, comm_state,
+                    rng, margs, mkwargs, loss_args_flat,
                 )
-        # non-boundary micro-steps never touch the optimizer state: it stays
-        # wherever it lives (device, pinned host, or the disk tier) and the
-        # caller's reference is echoed untouched
+        # non-boundary micro-steps never touch the optimizer state or the
+        # transport state (quantization is once-per-step): both stay
+        # wherever they live and the caller's references are echoed
+        # untouched
         with xprof_span("stoke/dispatch"):
             (report, updated, new_vars, new_buf, new_scaler, new_rng,
              finite) = self._accum_cache[key](
@@ -1228,7 +1290,7 @@ class StepEngine:
                 loss_args_flat,
             )
         return (report, updated, new_vars, opt_state, new_buf, new_scaler,
-                new_rng, finite)
+                comm_state, new_rng, finite)
 
     def _build_fused(self, loss_treedef, deferred_info, do_apply):
         accum = self._accum_core(loss_treedef, deferred_info, training=True)
@@ -1236,8 +1298,8 @@ class StepEngine:
 
         if do_apply:
 
-            def _fused(variables, opt_state, grad_buf, scaler_state, rng,
-                       margs, mkwargs, larr):
+            def _fused(variables, opt_state, grad_buf, scaler_state,
+                       comm_state, rng, margs, mkwargs, larr):
                 # host-offloaded params → HBM ONCE for both accum and apply
                 # (the cores' own transfers become no-ops on already-device
                 # params)
@@ -1247,11 +1309,12 @@ class StepEngine:
                     larr
                 )
                 merged = {**variables, **updated}
-                new_vars, new_opt, zero_buf, new_scaler, finite = apply_core(
-                    merged, opt_state, new_buf, scaler_mid
+                new_vars, new_opt, zero_buf, new_scaler, new_comm, finite = (
+                    apply_core(merged, opt_state, new_buf, scaler_mid,
+                               comm_state)
                 )
                 return (report, updated, new_vars, new_opt, zero_buf,
-                        new_scaler, new_rng, finite)
+                        new_scaler, new_comm, new_rng, finite)
 
             if self.rules is not None:
                 repl = self._repl
@@ -1262,13 +1325,14 @@ class StepEngine:
                     self._opt_shardings,
                     self._grad_shardings,
                     self._scaler_shardings(),
+                    self._comm_state_shardings(),
                     repl,  # rng
                     repl,  # finite
                 )
                 return jax.jit(
-                    _fused, out_shardings=out_sh, donate_argnums=(0, 1, 2)
+                    _fused, out_shardings=out_sh, donate_argnums=(0, 1, 2, 4)
                 )
-            return jax.jit(_fused, donate_argnums=(0, 1, 2))
+            return jax.jit(_fused, donate_argnums=(0, 1, 2, 4))
 
         def _fused_nb(variables, grad_buf, scaler_state, rng, margs, mkwargs,
                       larr):

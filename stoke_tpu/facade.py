@@ -273,6 +273,7 @@ class Stoke:
             offload_params=st.offload_params_config,
             loss_weights=loss_weights,
             aux_loss_weight=aux_loss_weight,
+            comm=st.comm_config,
         )
         if self._rules is not None:
             opt_shapes = jax.eval_shape(self._optimizer.init, variables["params"])
@@ -327,6 +328,16 @@ class Stoke:
         self._scaler_state = self._place_scalar_tree(
             init_scaler_state(st.precision_config)
         )
+        # gradient-transport state (ISSUE 2): error-feedback residual +
+        # stochastic-rounding rng, threaded through every apply path like
+        # the scaler state.  Empty dict when no CommConfig (or fp32
+        # pass-through) — structurally free.  Transient like the sown
+        # "losses" collection: not checkpointed (worst case a restart
+        # loses one step's quantization residual).
+        self._comm_state = self._engine.init_comm_state(self._variables)
+        # analytic per-step bytes-on-wire of the gradient exchange
+        # (telemetry counters; None without a CommConfig)
+        self._comm_bytes = self._engine.comm_bytes_per_step(self._variables)
         # create the key host-side: PRNGKey dispatches on the DEFAULT
         # backend, which may be a (possibly unreachable) accelerator even
         # when this run targets cpu.  LOCAL device: in multi-process runs
@@ -395,8 +406,13 @@ class Stoke:
 
         from jax.sharding import SingleDeviceSharding
 
-        target = SingleDeviceSharding(self._device, memory_kind="pinned_host")
         try:
+            # construction itself validates memory kinds on newer jax
+            # (ValueError for backends without pinned_host) — it belongs
+            # inside the probe, not before it
+            target = SingleDeviceSharding(
+                self._device, memory_kind="pinned_host"
+            )
             with jax.default_device(self._device):
                 jax.device_put(jnp.zeros((1,), jnp.float32), target)
             return target
@@ -700,12 +716,14 @@ class Stoke:
             new_opt,
             self._grad_buf,
             self._scaler_state,
+            self._comm_state,
             finite,
         ) = self._engine.apply_step(
             self._variables,
             self._opt_materialize(),
             self._grad_buf,
             self._scaler_state,
+            self._comm_state,
         )
         self._opt_commit(new_opt)
         if t0 is not None:
@@ -779,6 +797,7 @@ class Stoke:
             new_opt,
             self._grad_buf,
             self._scaler_state,
+            self._comm_state,
             self._rng,
             finite,
         ) = self._engine.fused_step(
@@ -786,6 +805,7 @@ class Stoke:
             self._opt_materialize() if do_apply else self._opt_state,
             self._grad_buf,
             self._scaler_state,
+            self._comm_state,
             self._rng,
             margs,
             mkwargs,
@@ -930,6 +950,23 @@ class Stoke:
         except Exception:
             self._last_grad_norm = None
 
+    def _sample_comm_residual_norm(self) -> Optional[float]:
+        """Global norm of the error-feedback residual (one device
+        reduction + fetch, only at the logging cadence) — the
+        "quantization error being carried" gauge; near-constant norm over
+        training is the error-feedback-working signal."""
+        residual = (self._comm_state or {}).get("residual")
+        if residual is None:
+            return None
+        try:
+            import optax
+
+            norm = float(jax.device_get(optax.global_norm(residual)))
+            self._telemetry.registry.gauge("comm/residual_norm").set(norm)
+            return norm
+        except Exception:
+            return None
+
     def _maybe_emit_telemetry(self, window: int = 1) -> None:
         """Assemble + emit one structured step event at the telemetry
         cadence (JSONL / Prometheus / TB sinks).  Device->host transfers
@@ -941,6 +978,17 @@ class Stoke:
         # (global) effective batch — counted per boundary, emitted at the
         # cadence
         t.add_samples((self._status_obj.effective_batch_size or 0) * window)
+        # gradient bytes-on-wire: analytic per-step counts (ISSUE 2) —
+        # ``prequant`` what the fp32 schedule would move, ``onwire`` what
+        # the configured wire dtype moves; the JSONL record carries the
+        # per-window deltas so the compression win is measurable per run
+        if self._comm_bytes is not None:
+            t.registry.counter("comm/grad_bytes_prequant_total").inc(
+                self._comm_bytes["prequant"] * window
+            )
+            t.registry.counter("comm/grad_bytes_onwire_total").inc(
+                self._comm_bytes["onwire"] * window
+            )
         if not self._crossed_boundary(
             self._optimizer_steps, t.config.log_every_n_steps, window
         ):
@@ -954,6 +1002,7 @@ class Stoke:
             grad_norm=self._last_grad_norm,
             loss_scale=self.loss_scale if scaled else None,
             skipped_steps=self.skipped_optimizer_steps if scaled else 0.0,
+            comm_residual_norm=self._sample_comm_residual_norm(),
         )
         self._last_grad_norm = None
 
@@ -1058,6 +1107,7 @@ class Stoke:
             new_opt,
             self._grad_buf,
             self._scaler_state,
+            self._comm_state,
             self._rng,
             finite,
         ) = self._engine.window_step(
@@ -1065,6 +1115,7 @@ class Stoke:
             self._opt_materialize(),
             self._grad_buf,
             self._scaler_state,
+            self._comm_state,
             self._rng,
             margs,
             mkwargs,
@@ -1235,6 +1286,7 @@ class Stoke:
             new_opt,
             self._grad_buf,
             self._scaler_state,
+            self._comm_state,
             self._rng,
             skipped,
         ) = self._engine.multi_step(
@@ -1242,6 +1294,7 @@ class Stoke:
             self._opt_materialize(),
             self._grad_buf,
             self._scaler_state,
+            self._comm_state,
             self._rng,
             margs,
             mkwargs,
@@ -1525,6 +1578,7 @@ class Stoke:
             opt_arg,
             self._grad_buf,
             self._scaler_state,
+            self._comm_state,
             self._rng,
             margs,
             {},

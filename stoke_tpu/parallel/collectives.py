@@ -1,0 +1,355 @@
+"""Pluggable gradient transport: quantized collectives with error feedback.
+
+ISSUE 2 tentpole.  The DP/ZeRO path syncs full-precision gradients through
+compiler-inserted all-reduces (parallel/sharding.py module docstring), so
+gradient bytes-on-wire are the scaling tax of every multi-chip config.
+EQuARX (arXiv:2506.17615) shows a quantized all-reduce inside XLA recovers
+most of that bandwidth at negligible quality cost; this module is the
+JAX-level analogue, applied ONCE per optimizer step at the apply boundary:
+
+1. **Bucketed flattening** — gradient leaves are concatenated (tree order)
+   into flat fp32 buckets of ``CommConfig.bucket_mb``, so dozens of small
+   conv/BN gradients ride ONE collective instead of one each.
+2. **Quantized exchange** (``strategy="rs_ag"``) — each bucket goes through
+   reduce-scatter → per-chunk-scaled (stochastic-rounding) int8/bf16
+   quantize of the owned shard → all-gather of payload + scales →
+   dequantize.  ``"all_reduce"`` is the single-stage variant (one quantize,
+   one summed exchange).
+3. **Error feedback** — the per-leaf residual ``x - transport(x)`` is
+   carried in engine state and added back to the NEXT step's gradients
+   before quantizing, so quantization error accumulates into the model
+   instead of being lost (EF-SGD lineage, arXiv:1901.09847) and int8
+   training tracks the fp32 loss trajectory.
+
+Simulation fidelity: under GSPMD the pre-reduction partial gradients are
+not addressable from JAX, so the reduce-scatter leg quantizes the
+logically-reduced value (one quantization error) where a compiler-level
+implementation quantizes each partial (~N errors averaged); wire format,
+byte accounting, and the error-feedback machinery are identical, and the
+residual absorbs either noise source.  ``dtype="fp32"`` is an exact
+pass-through — bit-identical to running without a transport.
+
+The math helpers (:func:`quantize_chunks` / :func:`dequantize_chunks` /
+:func:`bucket_layout`) are pure and unit-tested in isolation
+(tests/test_collectives.py); :class:`GradTransport` wires them to the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from stoke_tpu.configs import CommConfig
+from stoke_tpu.ops.attention import shard_map
+from stoke_tpu.utils.trees import tree_zeros_like
+
+#: int8 wire range is symmetric [-127, 127] (−128 unused so the scale maps
+#: max|x| exactly onto the grid and negation is lossless)
+_INT8_MAX = 127.0
+
+
+# --------------------------------------------------------------------------- #
+# Pure quantization math
+# --------------------------------------------------------------------------- #
+
+
+def quantize_chunks(
+    x: jax.Array,
+    chunk: int,
+    rng: Optional[jax.Array] = None,
+    stochastic: bool = True,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-chunk absmax int8 quantization of a flat fp32 vector.
+
+    ``x`` has length divisible by ``chunk``; elements ``[i*chunk,
+    (i+1)*chunk)`` share one f32 scale ``max|x_chunk| / 127``.  Stochastic
+    rounding (``floor(v + u)``, ``u ~ U[0,1)``) is unbiased:
+    ``E[dequantize(quantize(x))] = x`` — the property that lets error
+    feedback converge.  Returns ``(q int8 [L], scales f32 [L/chunk])``.
+    """
+    x2 = x.reshape(-1, chunk)
+    absmax = jnp.max(jnp.abs(x2), axis=1)
+    scales = absmax / _INT8_MAX
+    safe = jnp.where(scales > 0, scales, 1.0)
+    v = x2 / safe[:, None]
+    if stochastic:
+        if rng is None:
+            raise ValueError("stochastic rounding needs an rng key")
+        u = jax.random.uniform(rng, v.shape, dtype=v.dtype)
+        q = jnp.floor(v + u)
+    else:
+        q = jnp.round(v)
+    q = jnp.clip(q, -_INT8_MAX, _INT8_MAX).astype(jnp.int8)
+    return q.reshape(-1), scales
+
+
+def dequantize_chunks(q: jax.Array, scales: jax.Array, chunk: int) -> jax.Array:
+    """Inverse of :func:`quantize_chunks` (up to rounding): int8 payload +
+    per-chunk scales → flat fp32."""
+    q2 = q.reshape(-1, chunk).astype(jnp.float32)
+    return (q2 * scales[:, None]).reshape(-1)
+
+
+# --------------------------------------------------------------------------- #
+# Bucket layout (host-side, static per leaf-shape signature)
+# --------------------------------------------------------------------------- #
+
+
+class BucketLayout:
+    """Static flattening plan: which leaves ride which bucket.
+
+    ``buckets`` is a list of (leaf-index list, payload_elems, padded_elems);
+    padding rounds each bucket up to a multiple of ``align`` (world_size ×
+    chunk_elems) so reduce-scatter shards and quantization chunks tile
+    exactly.  Computed once per gradient-tree shape signature and cached by
+    the transport (pure host arithmetic — never traced).
+    """
+
+    def __init__(self, sizes: List[int], bucket_elems: int, align: int):
+        self.sizes = list(sizes)
+        self.buckets: List[Tuple[List[int], int, int]] = []
+        current: List[int] = []
+        current_elems = 0
+        for i, n in enumerate(sizes):
+            if current and current_elems + n > bucket_elems:
+                self._close(current, current_elems, align)
+                current, current_elems = [], 0
+            current.append(i)
+            current_elems += n
+        if current:
+            self._close(current, current_elems, align)
+
+    def _close(self, indices: List[int], elems: int, align: int) -> None:
+        padded = -(-elems // align) * align
+        self.buckets.append((indices, elems, padded))
+
+    @property
+    def total_padded_elems(self) -> int:
+        return sum(p for _, _, p in self.buckets)
+
+
+# --------------------------------------------------------------------------- #
+# The transport
+# --------------------------------------------------------------------------- #
+
+
+class GradTransport:
+    """Applies the configured gradient exchange to a (replicated) gradient
+    pytree inside the compiled apply step.
+
+    Stateless apart from host-side layout caches; the carried state
+    (residual + rng) lives in the facade and threads through the engine's
+    compiled functions like the scaler state does.
+    """
+
+    def __init__(
+        self,
+        cfg: Optional[CommConfig],
+        mesh: Optional[Any],
+        axis_name: str = "data",
+    ):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.axis_name = axis_name
+        if mesh is not None and axis_name in mesh.axis_names:
+            self.world = int(mesh.shape[axis_name])
+        else:
+            self.world = 1
+        self._layout_cache: Dict[Tuple[int, ...], BucketLayout] = {}
+
+    # ------------------------------ state ------------------------------ #
+
+    @property
+    def active(self) -> bool:
+        """True when the transport transforms gradients at all
+        (``dtype="fp32"`` is a structural pass-through: no state, no
+        collectives, bit-identical numerics)."""
+        return self.cfg is not None and self.cfg.dtype != "fp32"
+
+    @property
+    def error_feedback(self) -> bool:
+        return self.active and bool(self.cfg.error_feedback)
+
+    def init_state(self, params: Any, seed: int = 0) -> Dict[str, Any]:
+        """Carried transport state: the stochastic-rounding rng stream and
+        (with error feedback) the per-leaf residual pytree.  Empty dict when
+        inactive, so inactive runs compile the exact same program as before
+        the transport existed."""
+        if not self.active:
+            return {}
+        # raw threefry key as host numpy (same layout as
+        # jax.random.PRNGKey) — creation must not touch the default
+        # accelerator backend; the facade places it explicitly
+        state: Dict[str, Any] = {
+            "rng": np.array([0, seed], dtype=np.uint32)
+        }
+        if self.error_feedback:
+            state["residual"] = tree_zeros_like(params)
+        return state
+
+    def state_shardings(self, grad_shardings: Any, replicated: Any) -> Any:
+        """out_shardings tree matching :meth:`init_state`'s structure."""
+        if not self.active:
+            return {}
+        sh: Dict[str, Any] = {"rng": replicated}
+        if self.error_feedback:
+            sh["residual"] = grad_shardings
+        return sh
+
+    # --------------------------- accounting ---------------------------- #
+
+    def bytes_per_step(self, params: Any) -> Optional[Dict[str, int]]:
+        """Analytic per-device bytes-on-wire of ONE optimizer step's
+        gradient exchange (telemetry; host arithmetic from the static
+        layout).  ``prequant`` is what the same schedule moves in fp32;
+        ``onwire`` what the configured wire dtype moves.  Ring collectives
+        move ``(N-1)/N x payload`` per device per stage; rs_ag and
+        all-reduce both comprise two such stages."""
+        if self.cfg is None:
+            return None
+        leaves = jax.tree_util.tree_leaves(params)
+        layout = self._layout([int(np.prod(l.shape)) if l.shape else 1
+                               for l in leaves])
+        elems = layout.total_padded_elems
+        chunks = elems // max(self.cfg.chunk_elems, 1)
+        ring = 2.0 * (self.world - 1) / max(self.world, 1)
+        pre = ring * 4.0 * elems
+        if self.cfg.dtype == "fp32":
+            wire = pre
+        elif self.cfg.dtype == "bf16":
+            wire = ring * 2.0 * elems
+        else:  # int8 payload + one f32 scale per chunk
+            wire = ring * (1.0 * elems + 4.0 * chunks)
+        return {"prequant": int(pre), "onwire": int(wire)}
+
+    # ----------------------------- apply ------------------------------- #
+
+    def apply(
+        self, grads: Any, state: Dict[str, Any]
+    ) -> Tuple[Any, Dict[str, Any]]:
+        """Transport a gradient pytree; returns ``(synced_grads,
+        new_state)``.  Error feedback is the outer formulation: the residual
+        is whatever the transport lost this step (``x - transport(x)``),
+        re-injected next step — exact for any inner exchange, and exactly
+        zero for the fp32 pass-through."""
+        if not self.active:
+            return grads, state
+        rng = state["rng"]
+        new_rng, sub = jax.random.split(rng)
+        if self.error_feedback:
+            x = jax.tree_util.tree_map(
+                lambda g, r: g + r.astype(g.dtype), grads, state["residual"]
+            )
+        else:
+            x = grads
+        y = self._exchange_tree(x, sub)
+        new_state: Dict[str, Any] = {"rng": new_rng}
+        if self.error_feedback:
+            new_state["residual"] = jax.tree_util.tree_map(
+                lambda a, b: (a - b).astype(a.dtype), x, y
+            )
+        return y, new_state
+
+    # ----------------------- bucketed tree plumbing -------------------- #
+
+    def _layout(self, sizes: List[int]) -> BucketLayout:
+        key = tuple(sizes)
+        if key not in self._layout_cache:
+            cfg = self.cfg
+            bucket_elems = max(int(cfg.bucket_mb * 2**20 / 4), 1)
+            align = max(self.world, 1) * max(cfg.chunk_elems, 1)
+            self._layout_cache[key] = BucketLayout(sizes, bucket_elems, align)
+        return self._layout_cache[key]
+
+    def _exchange_tree(self, tree: Any, rng: jax.Array) -> Any:
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        sizes = [int(np.prod(l.shape)) if l.shape else 1 for l in leaves]
+        layout = self._layout(sizes)
+        outs: List[Any] = [None] * len(leaves)
+        for b, (indices, elems, padded) in enumerate(layout.buckets):
+            flat = jnp.concatenate(
+                [leaves[i].astype(jnp.float32).reshape(-1) for i in indices]
+            )
+            if padded > elems:
+                flat = jnp.pad(flat, (0, padded - elems))
+            out = self._exchange_flat(flat, jax.random.fold_in(rng, b))
+            off = 0
+            for i in indices:
+                n = sizes[i]
+                outs[i] = (
+                    out[off:off + n]
+                    .reshape(leaves[i].shape)
+                    .astype(leaves[i].dtype)
+                )
+                off += n
+        return jax.tree_util.tree_unflatten(treedef, outs)
+
+    # ------------------------- flat exchange --------------------------- #
+
+    def _exchange_flat(self, flat: jax.Array, rng: jax.Array) -> jax.Array:
+        """One bucket through the configured exchange.  With a real mesh
+        axis the collectives run inside shard_map (explicit
+        psum_scatter/all_gather on the wire payload); single-device falls
+        back to the same quantization round trip without collectives, so
+        the numerics are testable anywhere."""
+        if self.mesh is None or self.world <= 1:
+            return self._roundtrip_local(flat, rng)
+        fn = shard_map(
+            lambda x, key: self._wire_exchange(x, key),
+            self.mesh,
+            in_specs=(P(), P()),
+            out_specs=P(),
+        )
+        return fn(flat, rng)
+
+    def _roundtrip_local(self, flat: jax.Array, rng: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        k1, k2 = jax.random.split(rng)
+        if cfg.strategy == "rs_ag":
+            flat = self._quant_roundtrip(flat, k1)
+        return self._quant_roundtrip(flat, k2)
+
+    def _quant_roundtrip(self, x: jax.Array, key: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        if cfg.dtype == "bf16":
+            return x.astype(jnp.bfloat16).astype(jnp.float32)
+        q, s = quantize_chunks(
+            x, cfg.chunk_elems, key, cfg.stochastic_rounding
+        )
+        return dequantize_chunks(q, s, cfg.chunk_elems)
+
+    def _wire_exchange(self, x: jax.Array, key: jax.Array) -> jax.Array:
+        """Per-shard body (inside shard_map): the actual collective
+        schedule.  ``x`` arrives replicated (the logically-reduced bucket);
+        the quantize→exchange→dequantize path models the wire format of
+        the compiler-level quantized collective (module docstring)."""
+        cfg = self.cfg
+        axis = self.axis_name
+        n = self.world
+        chunk = cfg.chunk_elems
+        # both schedules put the local tensor on the wire first; the
+        # round-trip helper IS the wire format (shared with the
+        # single-device fallback so the two paths cannot diverge)
+        xq = self._quant_roundtrip(x, key)
+        if cfg.strategy == "all_reduce":
+            # single-stage: exchange the wire-format payload, average.
+            # One quantization error total.
+            return lax.psum(xq, axis) / n
+        # rs_ag: reduce-scatter the wire-format payload, then each device
+        # quantizes the shard it owns and all-gathers payload + scales
+        # (weight-update-sharding-compatible; both legs ride the wire dtype)
+        own = lax.psum_scatter(xq, axis, scatter_dimension=0, tiled=True) / n
+        if cfg.dtype == "bf16":
+            own_w = own.astype(jnp.bfloat16)
+            gathered = lax.all_gather(own_w, axis, axis=0, tiled=True)
+            return gathered.astype(jnp.float32)
+        key2 = jax.random.fold_in(key, lax.axis_index(axis) + 1)
+        q2, s2 = quantize_chunks(own, chunk, key2, cfg.stochastic_rounding)
+        qg = lax.all_gather(q2, axis, axis=0, tiled=True)
+        sg = lax.all_gather(s2, axis, axis=0, tiled=True)
+        return dequantize_chunks(qg, sg, chunk)

@@ -86,7 +86,18 @@ def initialize_distributed(cfg: DistributedInitConfig) -> bool:
             _DIST_INITIALIZED = True
             return True
     except AttributeError:
-        pass
+        # older jax exposes no is_initialized(); probe the client state
+        # directly (a second initialize() on these versions raises a
+        # "must be called before any JAX computations" RuntimeError that
+        # the already-initialized fallback below cannot recognize)
+        try:
+            from jax._src import distributed as _dist
+
+            if getattr(_dist.global_state, "client", None) is not None:
+                _DIST_INITIALIZED = True
+                return True
+        except Exception:
+            pass
     explicit = cfg.num_processes is not None or cfg.coordinator_address is not None
     if not explicit and not _multihost_env_present():
         return False

@@ -24,10 +24,13 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 from stoke_tpu.configs import (
     ALL_CONFIG_CLASSES,
+    COMM_DTYPES,
+    COMM_STRATEGIES,
     ActivationCheckpointingConfig,
     CheckpointConfig,
     ClipGradConfig,
     ClipGradNormConfig,
+    CommConfig,
     DataParallelConfig,
     DeviceOptions,
     DistributedInitConfig,
@@ -361,6 +364,77 @@ class StokeStatus:
                 f"writable: {err}"
             )
 
+        def _comm_invalid(s):
+            """Gradient-transport legality (ISSUE 2): a CommConfig that
+            would silently do nothing (no distributed engine), that names
+            an unknown dtype/strategy, or that combines quantization with
+            incompatible features (sharded grad buffers, fp16 loss
+            scalers) is rejected HERE — not at compile time, not
+            silently."""
+            cfg = self._configs.get("CommConfig")
+            if cfg is None:
+                return False
+            if s["distributed"] is None:
+                return (
+                    "CommConfig supplied but distributed=None; the gradient "
+                    "transport would be silently ignored — set "
+                    "distributed='dp' or drop the config"
+                )
+            if cfg.dtype not in COMM_DTYPES:
+                return (
+                    f"CommConfig.dtype {cfg.dtype!r} unknown; valid: "
+                    f"{list(COMM_DTYPES)}"
+                )
+            if cfg.strategy not in COMM_STRATEGIES:
+                return (
+                    f"CommConfig.strategy {cfg.strategy!r} unknown; valid: "
+                    f"{list(COMM_STRATEGIES)}"
+                )
+            if cfg.bucket_mb <= 0:
+                return f"CommConfig.bucket_mb must be > 0, got {cfg.bucket_mb}"
+            if cfg.chunk_elems < 1:
+                return (
+                    f"CommConfig.chunk_elems must be >= 1, got "
+                    f"{cfg.chunk_elems}"
+                )
+            if cfg.dtype == "fp32":
+                return False  # pass-through composes with everything
+            if s["sddp"] or s["fsdp"]:
+                # sddp/fsdp shard the gradient accumulation buffer over the
+                # data axis; the quantized transport assumes a replicated
+                # buffer it can reduce-scatter itself (quantizing an
+                # already-scattered buffer would double-shard).  oss is
+                # fine: opt-state sharding composes with a replicated
+                # gradient exchange (weight-update sharding, 2004.13336).
+                tier = "fsdp" if s["fsdp"] else "sddp"
+                return (
+                    f"CommConfig(dtype={cfg.dtype!r}) conflicts with "
+                    f"{tier} gradient sharding — the quantized transport "
+                    f"owns the gradient collective and needs the replicated "
+                    f"grad buffer of tiers none/oss"
+                )
+            if s["precision"] is PrecisionOptions.fp16:
+                # fp16 carries dynamic loss scalers: the single-scaler mode
+                # stores SCALED grads in the buffer (quantization chunk
+                # scales would alias the loss scale) and per-loss mode
+                # updates scaler state from per-micro finiteness — both
+                # interact with lossy transport in ways v1 does not support
+                return (
+                    f"CommConfig(dtype={cfg.dtype!r}) with precision='fp16' "
+                    f"is unsupported — the dynamic loss scaler interacts "
+                    f"with lossy gradient transport; use bf16 (the TPU "
+                    f"path) or full precision"
+                )
+            dp = self._configs.get("DataParallelConfig")
+            axis = dp.axis_name if dp is not None else "data"
+            if axis not in self._mesh_axes():
+                return (
+                    f"CommConfig(dtype={cfg.dtype!r}) exchanges gradients "
+                    f"over mesh axis {axis!r} but the mesh only has axes "
+                    f"{list(self._mesh_axes())} — add it to MeshConfig.axes"
+                )
+            return False
+
         def _offload_cpu_no_fallback(s):
             for name in ("OffloadOptimizerConfig", "OffloadParamsConfig"):
                 cfg = self._configs.get(name)
@@ -481,6 +555,10 @@ class StokeStatus:
             (
                 _profiler_invalid,
                 "ProfilerConfig.trace_dir is not writable",
+            ),
+            (
+                _comm_invalid,
+                "CommConfig is invalid for this combination",
             ),
             (
                 _offload_cpu_no_fallback,
@@ -642,6 +720,13 @@ class StokeStatus:
     @property
     def fsdp_config(self) -> FSDPConfig:
         return self._get_or_default(FSDPConfig)
+
+    @property
+    def comm_config(self) -> Optional[CommConfig]:
+        """None unless explicitly supplied (the gradient-transport layer is
+        opt-in and defaults OFF; without it gradients sync through the
+        compiler-inserted fp32 collectives exactly as before)."""
+        return self._configs.get("CommConfig")
 
     @property
     def partition_rules_config(self):

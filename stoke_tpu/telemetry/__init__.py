@@ -230,6 +230,7 @@ class Telemetry:
         grad_norm: Optional[float] = None,
         loss_scale=None,
         skipped_steps: float = 0.0,
+        comm_residual_norm: Optional[float] = None,
         tokens_hint: Optional[float] = None,
         ts: Optional[float] = None,
     ) -> Optional[dict]:
@@ -271,6 +272,16 @@ class Telemetry:
             dev_hist.ema if isinstance(dev_hist, Histogram) else None
         )
 
+        # gradient-transport bytes (ISSUE 2): per-window deltas of the
+        # analytic bytes-on-wire counters the facade increments per
+        # optimizer step; null when no transport is configured
+        if self.registry.get("comm/grad_bytes_prequant_total") is not None:
+            comm_pre = self._delta("comm/grad_bytes_prequant_total")
+            comm_wire = self._delta("comm/grad_bytes_onwire_total")
+            comm_ratio = comm_pre / comm_wire if comm_wire else None
+        else:
+            comm_pre = comm_wire = comm_ratio = None
+
         if self.compile_tracker is not None:
             compiles = self.compile_tracker.compiles
             recompiles = self.compile_tracker.recompiles
@@ -297,6 +308,10 @@ class Telemetry:
             loss_scale=loss_scale,
             loss_scale_events=self.note_loss_scale(loss_scale),
             skipped_steps=skipped_steps,
+            comm_bytes_prequant=comm_pre,
+            comm_bytes_onwire=comm_wire,
+            comm_compression=comm_ratio,
+            comm_residual_norm=comm_residual_norm,
             compiles_total=compiles,
             recompiles=recompiles,
             compile_time_s=compile_time,

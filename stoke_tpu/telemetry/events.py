@@ -64,6 +64,15 @@ STEP_EVENT_FIELDS: Dict[str, tuple] = {
     "compiles_total": (True, "int"),
     "recompiles": (True, "int"),
     "compile_time_s": (True, "number"),
+    # gradient-transport accounting (ISSUE 2; null without a CommConfig):
+    # per-window bytes the gradient exchange moves per device — prequant is
+    # the fp32 schedule's bytes, onwire the configured wire dtype's;
+    # compression = prequant/onwire; residual_norm gauges the carried
+    # error-feedback residual
+    "comm_bytes_prequant": (False, "nullable_number"),
+    "comm_bytes_onwire": (False, "nullable_number"),
+    "comm_compression": (False, "nullable_number"),
+    "comm_residual_norm": (False, "nullable_number"),
     "hbm_bytes_in_use": (False, "nullable_number"),
     "hbm_peak_bytes": (False, "nullable_number"),
     "hbm_bytes_limit": (False, "nullable_number"),
@@ -162,6 +171,10 @@ def build_step_event(
     loss_scale=None,
     loss_scale_events: int = 0,
     skipped_steps: float = 0.0,
+    comm_bytes_prequant: Optional[float] = None,
+    comm_bytes_onwire: Optional[float] = None,
+    comm_compression: Optional[float] = None,
+    comm_residual_norm: Optional[float] = None,
     hbm_bytes_in_use: Optional[int] = None,
     hbm_peak_bytes: Optional[int] = None,
     hbm_bytes_limit: Optional[int] = None,
@@ -193,6 +206,14 @@ def build_step_event(
         "compiles_total": int(compiles_total),
         "recompiles": int(recompiles),
         "compile_time_s": _round(compile_time_s),
+        "comm_bytes_prequant": (
+            None if comm_bytes_prequant is None else float(comm_bytes_prequant)
+        ),
+        "comm_bytes_onwire": (
+            None if comm_bytes_onwire is None else float(comm_bytes_onwire)
+        ),
+        "comm_compression": _round(comm_compression, 4),
+        "comm_residual_norm": _round(comm_residual_norm),
         "hbm_bytes_in_use": hbm_bytes_in_use,
         "hbm_peak_bytes": hbm_peak_bytes,
         "hbm_bytes_limit": hbm_bytes_limit,

