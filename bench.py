@@ -171,7 +171,9 @@ REGRESSION_TOLERANCE = 0.05
 #: capture-config keys whose mismatch vs the ledger best marks a comparison
 #: as cross-configuration (A/B arms, seg sweeps) rather than a like-for-like
 #: regression
-_REGRESSION_CONFIG_KEYS = ("xla_flags", "steps_per_dispatch", "comm_dtype")
+_REGRESSION_CONFIG_KEYS = (
+    "xla_flags", "steps_per_dispatch", "comm_dtype", "health"
+)
 
 
 def check_regression(
@@ -437,12 +439,21 @@ def main():
                     "for it); a default request accepts the best verified "
                     "record whatever its flags — flags are a tuning knob "
                     "of the same metric")
+    ap.add_argument("--health", action="store_true",
+                    help="enable the training health monitor (ISSUE 3): "
+                    "on-device sentinels + anomaly detectors ride the "
+                    "measured run and the capture's ledger descriptor "
+                    "records the anomaly counts.  Sentinels fetch a tiny "
+                    "vector per step (one host sync), so a --health "
+                    "capture is a distinct configuration for the "
+                    "stale-substitution guard")
     ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
     if not args._worker:
         sys.exit(_supervise(
             sys.argv[1:], args.preset,
             requested={
+                "health": True if args.health else None,
                 "api": args.api,
                 "batch": args.batch,
                 # explicit --seg N: a record at a different segment length
@@ -494,6 +505,26 @@ def main():
     variables = init_module(
         model, jax.random.PRNGKey(0), np.zeros((2, 32, 32, 3), np.float32), train=False
     )
+    run_configs = []
+    if args.comm_dtype:
+        run_configs.append(CommConfig(dtype=args.comm_dtype))
+    if args.health:
+        # health monitor arm (ISSUE 3): sentinels + detectors observe the
+        # measured run; the ledger descriptor records the anomaly counts.
+        # Telemetry is required by the status layer (sentinels surface
+        # through the step events) — JSONL only, quiet cadence, no
+        # device-time sampling, so the monitor itself is the only
+        # perturbation being measured.
+        import tempfile
+
+        from stoke_tpu import HealthConfig, TelemetryConfig
+
+        health_dir = tempfile.mkdtemp(prefix="stoke-bench-health-")
+        run_configs.append(TelemetryConfig(
+            output_dir=health_dir, log_every_n_steps=10,
+            prometheus=False, tensorboard=False, sample_device_time=False,
+        ))
+        run_configs.append(HealthConfig(dump_signals=False))
     stoke = Stoke(
         model=model,
         optimizer=StokeOptimizer(
@@ -509,9 +540,7 @@ def main():
         # chip the mesh is 1-wide and the arm measures quantize overhead
         distributed="dp" if args.comm_dtype else None,
         precision=None if tiny else "bf16",
-        configs=(
-            [CommConfig(dtype=args.comm_dtype)] if args.comm_dtype else None
-        ),
+        configs=run_configs or None,
         model_train_kwargs={"train": True},
         model_eval_kwargs={"train": False},
         verbose=False,
@@ -590,6 +619,13 @@ def main():
         result["xla_flags"] = args.xla_flags
     if args.comm_dtype:
         result["comm_dtype"] = args.comm_dtype
+    if args.health:
+        h = stoke.health
+        result["health"] = True
+        result["health_anomalies"] = h.anomaly_count
+        result["health_by_detector"] = h.anomaly_counts_by_detector()
+        result["health_bundles"] = len(h.recorder.dumps)
+        stoke.close_telemetry()
     if on_accel:
         regression = check_regression(
             result["metric"],
@@ -598,6 +634,7 @@ def main():
                 "xla_flags": args.xla_flags or None,
                 "steps_per_dispatch": per_call,
                 "comm_dtype": args.comm_dtype,
+                "health": True if args.health else None,
             },
         )
         if regression is not None:
@@ -629,6 +666,14 @@ def main():
                 "backend": jax.default_backend(),
                 **({"xla_flags": args.xla_flags} if args.xla_flags else {}),
                 **({"comm_dtype": args.comm_dtype} if args.comm_dtype else {}),
+                **(
+                    {
+                        "health": True,
+                        "health_anomalies": result["health_anomalies"],
+                    }
+                    if args.health
+                    else {}
+                ),
             },
             keep_best=True,
         )

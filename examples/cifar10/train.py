@@ -127,6 +127,19 @@ def build_stoke(cfg: dict) -> Stoke:
             CommConfig(**spec) if isinstance(spec, dict)
             else CommConfig(dtype=str(spec))
         )
+    if cfg.get("health"):
+        # health: {watchdog: true, watchdog_timeout_s: 300} — or just
+        # `health: true` for the defaults.  Training health monitor:
+        # on-device sentinels + anomaly detectors + crash flight recorder
+        # (docs/observability.md "Training health & post-mortems").
+        # Requires the telemetry block (status-validated).
+        from stoke_tpu import HealthConfig
+
+        spec = cfg["health"]
+        configs.append(
+            HealthConfig(**spec) if isinstance(spec, dict)
+            else HealthConfig()
+        )
     return Stoke(
         model=model,
         optimizer=StokeOptimizer(

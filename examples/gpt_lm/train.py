@@ -65,7 +65,16 @@ def main():
                     "output dir (JSONL step events incl. tokens/sec, "
                     "Prometheus exposition, recompile/HBM tracking — "
                     "docs/observability.md)")
+    ap.add_argument("--health", action="store_true",
+                    help="enable the training health monitor: on-device "
+                    "numerics sentinels, anomaly detectors, and a crash "
+                    "flight recorder writing post-mortem bundles under the "
+                    "telemetry dir (requires --telemetry; docs/"
+                    "observability.md \"Training health & post-mortems\")")
     args = ap.parse_args()
+    if args.health and not args.telemetry:
+        ap.error("--health requires --telemetry DIR (sentinels surface "
+                 "through the telemetry step events)")
 
     attention_fn, is_causal, mesh_cfgs = None, False, []
     if args.attention == "flash":
@@ -100,7 +109,12 @@ def main():
 
         configs.append(TelemetryConfig(
             output_dir=args.telemetry, log_every_n_steps=10, tensorboard=True,
+            grad_norm=args.health,
         ))
+    if args.health:
+        from stoke_tpu import HealthConfig
+
+        configs.append(HealthConfig())
     stoke = Stoke(
         model=model,
         optimizer=StokeOptimizer(
@@ -135,6 +149,13 @@ def main():
             f"epoch {epoch}: {dt:.1f}s ({n_tok / dt:.0f} tok/s) "
             f"ema_loss={stoke.ema_loss:.4f}"
         )
+    if args.health:
+        stoke.print_on_devices(
+            f"health: {stoke.health.anomaly_count} anomalies "
+            f"({stoke.health.anomaly_counts_by_detector() or 'clean run'})"
+        )
+    if args.telemetry:
+        stoke.close_telemetry()
 
 
 if __name__ == "__main__":

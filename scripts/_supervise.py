@@ -30,7 +30,27 @@ import os
 import selectors
 import subprocess
 import sys
+import tempfile
 import time
+
+#: exit code of a worker killed by the stoke health watchdog — kept in sync
+#: with stoke_tpu/telemetry/health.py WATCHDOG_EXIT_CODE (duplicated here
+#: because this module must never import jax-importing packages)
+HEALTH_WATCHDOG_EXIT_CODE = 113
+
+#: env var the flight recorder appends bundle paths to (kept in sync with
+#: stoke_tpu/telemetry/recorder.py BUNDLE_FILE_ENV)
+BUNDLE_FILE_ENV = "STOKE_HEALTH_BUNDLE_FILE"
+
+
+def _read_bundles(path: str) -> list[str]:
+    """Bundle paths the worker's flight recorder reported (empty when no
+    bundle was written or the handshake file is unreadable)."""
+    try:
+        with open(path) as f:
+            return [ln.strip() for ln in f if ln.strip()]
+    except OSError:
+        return []
 
 
 def supervise(
@@ -52,7 +72,16 @@ def supervise(
         print(json.dumps({"error": f"device probe failed: {e}"[:250]}))
         return 1
     deadline = time.time() + watchdog_seconds
-    env = {**os.environ, "STOKE_SESSION_DEADLINE": repr(deadline)}
+    # health-bundle handshake: a worker running with HealthConfig appends
+    # every post-mortem bundle path to this file, so a kill (ours or the
+    # in-process hang watchdog's) still surfaces WHERE the corpse is
+    bundle_fd, bundle_file = tempfile.mkstemp(prefix="stoke-bundles-")
+    os.close(bundle_fd)
+    env = {
+        **os.environ,
+        "STOKE_SESSION_DEADLINE": repr(deadline),
+        BUNDLE_FILE_ENV: bundle_file,
+    }
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(script_file), "--_worker", *argv],
         stdout=subprocess.PIPE,
@@ -104,6 +133,19 @@ def supervise(
                 _relay()
             if proc.poll() is not None:
                 _relay()
+                if proc.returncode == HEALTH_WATCHDOG_EXIT_CODE:
+                    # the worker's in-process hang watchdog killed it: a
+                    # distinct, diagnosable outcome (wedged collective /
+                    # dead tunnel), with the post-mortem bundle attached
+                    print(json.dumps({
+                        "error": (
+                            "worker killed by stoke health watchdog "
+                            f"(exit {HEALTH_WATCHDOG_EXIT_CODE}: no step "
+                            "completed within its timeout)"
+                        ),
+                        "watchdog_exit_code": HEALTH_WATCHDOG_EXIT_CODE,
+                        "bundles": _read_bundles(bundle_file),
+                    }))
                 return proc.returncode
             now = time.time()
             if now > deadline:
@@ -118,7 +160,16 @@ def supervise(
                 break
     finally:
         sel.close()
+        bundles = _read_bundles(bundle_file)
+        try:
+            os.remove(bundle_file)
+        except OSError:
+            pass
     proc.kill()
     proc.wait()
-    print(json.dumps({"error": why}))
+    err = {"error": why}
+    if bundles:
+        # a post-mortem bundle beats a bare "timed out": point at it
+        err["bundles"] = bundles
+    print(json.dumps(err))
     return 1

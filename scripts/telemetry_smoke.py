@@ -1,10 +1,12 @@
 """Telemetry smoke: ONE CPU train step with the full pipeline enabled.
 
 Proves the observability stack end-to-end in seconds (``make
-telemetry-smoke``): a JSONL step record (schema-validated on read-back), a
-Prometheus exposition file, and a TB event stream readable by the native
-frame parser.  Prints the step record and a one-line verdict; exit 0 only
-when all three sinks round-trip.
+telemetry-smoke``): a JSONL step record (schema-validated on read-back)
+carrying the health-sentinel fields, a Prometheus exposition file, a TB
+event stream readable by the native frame parser, and — since ISSUE 3 — a
+forced post-mortem bundle with the flight-recorder ring, all-thread
+stacks, and run config.  Prints the step record and a one-line verdict;
+exit 0 only when everything round-trips.
 """
 
 from __future__ import annotations
@@ -21,7 +23,12 @@ def main() -> int:
     import numpy as np
     import optax
 
-    from stoke_tpu import Stoke, StokeOptimizer, TelemetryConfig
+    from stoke_tpu import (
+        HealthConfig,
+        Stoke,
+        StokeOptimizer,
+        TelemetryConfig,
+    )
     from stoke_tpu.telemetry import read_step_events
     from stoke_tpu.utils.tb_writer import read_scalar_events
 
@@ -35,6 +42,7 @@ def main() -> int:
         tensorboard=True,
         grad_norm=True,
     )
+    hcfg = HealthConfig(dump_signals=False)
     stoke = Stoke(
         model=lambda p, x: x @ p["w"],
         optimizer=StokeOptimizer(
@@ -43,16 +51,38 @@ def main() -> int:
         loss=lambda o, y: ((o - y) ** 2).mean(),
         params={"w": np.ones((8, 4), np.float32)},
         batch_size_per_device=16,
-        configs=[cfg],
+        configs=[cfg, hcfg],
         verbose=False,
     )
     x = np.ones((16, 8), np.float32)
     y = np.zeros((16, 4), np.float32)
     stoke.train_step(x, (y,))
+    # forced post-mortem dump: the bundle a human reads after a crash —
+    # exercised end-to-end so the crash path is proven BEFORE the crash
+    bundle = stoke.health.dump("smoke")
     stoke.close_telemetry()
 
     records = read_step_events(os.path.join(out_dir, "steps.jsonl"))
     print(json.dumps(records[-1], sort_keys=True))
+    rec = records[-1]
+    # the read_step_events round-trip already schema-validated the record;
+    # additionally require the ISSUE 3 sentinel fields to be POPULATED
+    health_fields_ok = (
+        rec.get("grad_norm") is not None
+        and rec.get("param_norm") is not None
+        and rec.get("update_ratio") is not None
+        and rec.get("nonfinite_leaves") == 0.0
+        and rec.get("health_anomalies") == 0.0
+    )
+    bundle_files = set(os.listdir(bundle)) if os.path.isdir(bundle) else set()
+    bundle_ok = {
+        "manifest.json", "ring.jsonl", "config.json", "mesh.json",
+        "environment.json", "stacks.txt",
+    } <= bundle_files
+    ring_kinds = set()
+    if bundle_ok:
+        with open(os.path.join(bundle, "ring.jsonl")) as f:
+            ring_kinds = {json.loads(ln)["kind"] for ln in f if ln.strip()}
     prom = open(os.path.join(out_dir, "metrics.prom")).read()
     tb_dir = os.path.join(out_dir, "tb")
     tb_files = [
@@ -63,8 +93,12 @@ def main() -> int:
     ok = (
         len(records) == 1
         and records[0]["step"] == 1
+        and health_fields_ok
         and "stoke_jax_compiles_total" in prom
+        and "stoke_health_anomalies_total" in prom
         and any(t.startswith("telemetry/") for t, _, _ in tb_events)
+        and bundle_ok
+        and {"sentinels", "step_event"} <= ring_kinds
     )
     print(json.dumps({
         "telemetry_smoke": "ok" if ok else "FAILED",
@@ -72,6 +106,9 @@ def main() -> int:
         "jsonl_records": len(records),
         "prom_bytes": len(prom),
         "tb_scalars": len(tb_events),
+        "bundle": bundle,
+        "bundle_files": sorted(bundle_files),
+        "ring_kinds": sorted(ring_kinds),
     }))
     return 0 if ok else 1
 

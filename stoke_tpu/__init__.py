@@ -18,6 +18,7 @@ from stoke_tpu.configs import (
     DistributedInitConfig,
     DistributedOptions,
     FSDPConfig,
+    HealthConfig,
     LossReduction,
     MeshConfig,
     OffloadDiskConfig,
@@ -49,6 +50,7 @@ from stoke_tpu.engine import (
 )
 from stoke_tpu.facade import Stoke
 from stoke_tpu.status import StokeStatus, StokeValidationError
+from stoke_tpu.telemetry.health import HealthHaltError
 from stoke_tpu.utils import force_cpu, init_module
 
 __version__ = "0.1.0"
@@ -57,6 +59,7 @@ __all__ = [
     "Stoke",
     "StokeStatus",
     "StokeValidationError",
+    "HealthHaltError",
     "force_cpu",
     "init_module",
     "StokeOptimizer",
@@ -83,6 +86,7 @@ __all__ = [
     "OSSConfig",
     "SDDPConfig",
     "FSDPConfig",
+    "HealthConfig",
     "OffloadDiskConfig",
     "OffloadOptimizerConfig",
     "OffloadParamsConfig",

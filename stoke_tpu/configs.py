@@ -651,6 +651,128 @@ class TelemetryConfig:
     xprof_annotations: bool = True
 
 
+#: actions a health detector may take when it fires (validated by status.py)
+HEALTH_ACTIONS: Tuple[str, ...] = ("record", "warn", "dump", "halt")
+
+
+@dataclass
+class HealthConfig:
+    """Training health monitor (ISSUE 3 tentpole): on-device numerics
+    sentinels, host-side anomaly detectors, a crash flight recorder, and a
+    hang watchdog.
+
+    No reference equivalent (the reference's failure story is "crash = job
+    death", SURVEY.md §5).  At pod scale silent numerics faults and hangs
+    are first-order failures (arXiv:1909.09756), and the lossy int8
+    gradient transport (ISSUE 2, EQuARX lineage arXiv:2506.17615) makes a
+    standing error-feedback-divergence monitor a correctness requirement.
+    Four pieces:
+
+    1. **Sentinels** (``sentinels=True``): the compiled step additionally
+       returns a tiny packed vector of per-step diagnostics (loss, global
+       grad/param norms, update ratio, nonfinite-leaf count, scaler-skip
+       flag, comm residual norm) computed *inside* the existing jit — zero
+       extra device dispatches (this subsumes the host-side
+       ``TelemetryConfig.grad_norm`` extra reduction).
+    2. **Detectors**: host-side anomaly checks over the sentinel stream +
+       registry counters, each with a configurable action — ``record``
+       (count only), ``warn`` (count + warning), ``dump`` (count + write a
+       post-mortem bundle), ``halt`` (dump + raise
+       :class:`~stoke_tpu.telemetry.health.HealthHaltError` at the facade
+       boundary).
+    3. **Flight recorder**: a bounded ring of recent step events /
+       sentinel rows / anomalies; dumped as a post-mortem bundle directory
+       on anomaly ``dump``, uncaught step-path exception, SIGTERM/SIGUSR1,
+       or watchdog trip (see docs/observability.md "Training health &
+       post-mortems" for the bundle layout).
+    4. **Watchdog** (``watchdog=True``): a daemon thread armed per
+       dispatch that fires when no step completes within
+       ``watchdog_timeout_s`` (the wedged-collective / dead-tunnel case),
+       dumping all-thread stacks + the bundle and — with
+       ``watchdog_kill=True`` — exiting with a distinct code the
+       ``scripts/_supervise.py`` runner recognizes.
+
+    Attributes:
+        sentinels: compile the on-device diagnostics vector into every
+            step path (requires a ``TelemetryConfig``; status-validated).
+        ring_size: flight-recorder ring capacity (entries, FIFO).
+        bundle_dir: post-mortem bundle directory (default:
+            ``<TelemetryConfig.output_dir>/postmortem``).
+        detector_warmup_steps: steps before the spike detectors may fire
+            (their running mean/variance needs samples first).
+        ema_alpha: EMA weight of the detectors' running mean/variance.
+        loss_spike_zscore / loss_spike_action: fire when the step loss is
+            more than this many running standard deviations above its EMA.
+        grad_spike_zscore / grad_spike_action: same for the global grad
+            norm.
+        nonfinite_action: fire when any gradient leaf contains a
+            non-finite value.  ``halt`` is illegal under fp16 (the dynamic
+            scaler's skip handling already tolerates transient infs;
+            status-validated).
+        scaler_skip_streak / scaler_skip_action: fire after this many
+            CONSECUTIVE fp16 scaler-skipped steps (scale collapse).
+        recompile_storm_threshold / recompile_storm_window /
+        recompile_storm_action: fire when the structural recompile counter
+            (shape-signature collector) grows by >= threshold within the
+            window (steps).
+        starvation_streak / starvation_action: fire after this many
+            consecutive steps with loader starvation time accrued.
+        comm_residual_factor / comm_residual_action: fire when the
+            error-feedback residual norm exceeds factor x its own EMA
+            (quantization error outrunning re-injection) or goes
+            non-finite.
+        max_dumps: per-run cap applied separately to anomaly-triggered
+            and exception-triggered bundle dumps (signal/watchdog/manual
+            dumps are uncapped).
+        dump_on_exception: write a bundle when the facade step path dies
+            on an uncaught exception.
+        dump_signals: install SIGTERM/SIGUSR1 handlers that dump a bundle
+            (chained to any previous handler; main thread only).
+        watchdog / watchdog_timeout_s: arm a per-dispatch hang watchdog;
+            the timeout must be > 0 (status-validated).  The armed deadline
+            scales with the optimizer steps one dispatch covers (a
+            ``train_steps(n)`` segment gets ``n × timeout``), so
+            multi-step scans are not false-tripped.
+        watchdog_compile_grace_s: extra allowance added to the deadline
+            until the FIRST optimizer step completes — covering warm-up
+            XLA compilation, which can legitimately exceed the steady-state
+            step timeout.  Mid-run recompiles (new shapes) get no grace;
+            keep the timeout comfortably above your worst compile or pad
+            this.
+        watchdog_kill: after dumping, hard-exit the process with
+            ``WATCHDOG_EXIT_CODE`` (``stoke_tpu.telemetry.health``) so a
+            supervisor can distinguish "hung and self-terminated" from a
+            generic timeout.
+    """
+
+    sentinels: bool = True
+    ring_size: int = 256
+    bundle_dir: Optional[str] = None
+    detector_warmup_steps: int = 20
+    ema_alpha: float = 0.02
+    loss_spike_zscore: float = 6.0
+    loss_spike_action: str = "warn"
+    grad_spike_zscore: float = 6.0
+    grad_spike_action: str = "warn"
+    nonfinite_action: str = "dump"
+    scaler_skip_streak: int = 8
+    scaler_skip_action: str = "warn"
+    recompile_storm_threshold: int = 3
+    recompile_storm_window: int = 20
+    recompile_storm_action: str = "warn"
+    starvation_streak: int = 5
+    starvation_action: str = "record"
+    comm_residual_factor: float = 10.0
+    comm_residual_action: str = "warn"
+    max_dumps: int = 3
+    dump_on_exception: bool = True
+    dump_signals: bool = True
+    watchdog: bool = False
+    watchdog_timeout_s: float = 300.0
+    watchdog_compile_grace_s: float = 600.0
+    watchdog_kill: bool = False
+
+
 @dataclass
 class ProfilerConfig:
     """First-class profiling (SURVEY.md §5: native win over the reference's
@@ -707,6 +829,7 @@ ALL_CONFIG_CLASSES: Tuple[type, ...] = (
     PartitionRulesConfig,
     ActivationCheckpointingConfig,
     CheckpointConfig,
+    HealthConfig,
     ProfilerConfig,
     TelemetryConfig,
     TensorboardConfig,

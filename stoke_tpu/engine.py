@@ -51,6 +51,7 @@ from stoke_tpu.configs import (
 from stoke_tpu.parallel.collectives import GradTransport
 from stoke_tpu.parallel.sharding import ShardingRules, place_global_tree
 from stoke_tpu.telemetry.collectors import xprof_span
+from stoke_tpu.telemetry.health import compute_sentinels
 from stoke_tpu.utils.trees import tree_cast, tree_finite, tree_zeros_like
 
 
@@ -401,6 +402,7 @@ class StepEngine:
         loss_weights: Optional[Any] = None,
         aux_loss_weight: float = 0.01,
         comm: Optional[Any] = None,
+        health: Optional[Any] = None,
     ):
         self.adapter = adapter
         self.loss_fn = loss_fn
@@ -426,6 +428,22 @@ class StepEngine:
             rules.mesh if rules is not None else None,
             rules.axis_name if rules is not None else "data",
         )
+        # health sentinels (ISSUE 3): when on, the apply core additionally
+        # returns a packed per-step diagnostics vector computed INSIDE the
+        # same compiled program (zero extra dispatches).  When off, the
+        # sentinel slot is an empty pytree (None) and a None loss input is
+        # threaded — both contribute nothing to the flattened jit
+        # arguments, so the compiled programs are bit-identical to a build
+        # without the feature.
+        self.health = health
+        self.sentinels_enabled = bool(
+            health is not None and getattr(health, "sentinels", False)
+        )
+        # compiled-program invocation counter: one increment per device
+        # dispatch issued by this engine.  The health acceptance criterion
+        # ("sentinels add zero dispatches") asserts equality of this
+        # counter across health-on/off runs.
+        self.dispatch_count = 0
         self._accum_cache: Dict[Any, Callable] = {}
         self._fwd_cache: Dict[Any, Callable] = {}
         self._loss_cache: Dict[Any, Callable] = {}
@@ -643,6 +661,7 @@ class StepEngine:
 
             self._fwd_cache[key] = _fwd
         self._note_dispatch_shapes(key, margs, mkwargs)
+        self.dispatch_count += 1
         return self._fwd_cache[key](variables, rng, margs, mkwargs)
 
     def eval_fwd(self, variables, margs: tuple, mkwargs: dict):
@@ -663,6 +682,7 @@ class StepEngine:
 
             self._fwd_cache[key] = _efwd
         self._note_dispatch_shapes(key, margs, mkwargs)
+        self.dispatch_count += 1
         return self._fwd_cache[key](variables, margs, mkwargs)
 
     #: per-program cap on remembered shape signatures: beyond this the
@@ -734,6 +754,7 @@ class StepEngine:
                 loss_treedef, deferred_info, training
             )
         self._note_dispatch_shapes(struct_key, margs, mkwargs, loss_args_flat)
+        self.dispatch_count += 1
         with xprof_span("stoke/accum"):
             return self._accum_cache[struct_key](
                 variables, grad_buf, scaler_state, rng, margs, mkwargs,
@@ -954,7 +975,9 @@ class StepEngine:
 
         Stacked args carry the micro dimension on axis 0 (leaf shape
         [k, micro_batch, ...]).  Returns (reports_stacked, variables,
-        opt_state, grad_buf, scaler_state, comm_state, rng, finite).
+        opt_state, grad_buf, scaler_state, comm_state, rng, sentinels,
+        finite) — ``sentinels`` is the health diagnostics vector (None when
+        sentinels are off).
         """
         key = (
             "window",
@@ -967,11 +990,22 @@ class StepEngine:
         self._note_dispatch_shapes(
             key, margs_stacked, mkwargs_stacked, loss_args_flat_stacked
         )
+        self.dispatch_count += 1
         with xprof_span("stoke/dispatch"):
             return self._accum_cache[key](
                 variables, opt_state, grad_buf, scaler_state, comm_state,
                 rng, margs_stacked, mkwargs_stacked, loss_args_flat_stacked,
             )
+
+    def _report_loss(self, report):
+        """Boundary-loss scalar for the health sentinels (traced): sum over
+        loss leaves of each leaf's mean (collapsing any stacked micro axis),
+        times ``grad_accum`` — undivided micro-loss units, matching the
+        facade's ``step_loss`` tracking on every path."""
+        total = jnp.float32(0.0)
+        for l in jax.tree_util.tree_leaves(report):
+            total = total + jnp.asarray(l, jnp.float32).mean()
+        return total * jnp.float32(self.grad_accum)
 
     def _window_core(self, loss_treedef, deferred_info):
         """Unjitted whole-window core: inner ``lax.scan`` over the stacked
@@ -1004,11 +1038,15 @@ class StepEngine:
                 (margs_s, mkwargs_s, larr_s),
             )
             merged = {"params": params, **nonparam_f}
-            new_vars, new_opt, zero_buf, new_scaler, new_comm, finite = (
-                apply_core(merged, opt_state, new_buf, scaler_mid, comm_state)
+            loss_val = (
+                self._report_loss(reports) if self.sentinels_enabled else None
+            )
+            (new_vars, new_opt, zero_buf, new_scaler, new_comm, sentinels,
+             finite) = apply_core(
+                merged, opt_state, new_buf, scaler_mid, comm_state, loss_val
             )
             return (reports, new_vars, new_opt, zero_buf, new_scaler,
-                    new_comm, new_rng, finite)
+                    new_comm, new_rng, sentinels, finite)
 
         return _window
 
@@ -1024,8 +1062,9 @@ class StepEngine:
                 self._grad_shardings,
                 self._scaler_shardings(),
                 self._comm_state_shardings(),
-                repl,
-                repl,
+                repl,  # rng
+                self._sentinel_shardings(),
+                repl,  # finite
             )
             return jax.jit(
                 _window, out_shardings=out_sh, donate_argnums=(0, 1, 2, 4)
@@ -1058,7 +1097,8 @@ class StepEngine:
 
         Stacked args carry [n_steps, grad_accum, micro_batch, ...] leaves.
         Returns (reports [n, k, ...], variables, opt_state, grad_buf,
-        scaler_state, comm_state, rng, n_nonfinite_steps).
+        scaler_state, comm_state, rng, sentinels [n, S] (None when off),
+        n_nonfinite_steps).
         """
         key = (
             "multi",
@@ -1071,6 +1111,7 @@ class StepEngine:
         self._note_dispatch_shapes(
             key, margs_stacked, mkwargs_stacked, loss_args_flat_stacked
         )
+        self.dispatch_count += 1
         with xprof_span("stoke/dispatch"):
             return self._accum_cache[key](
                 variables, opt_state, grad_buf, scaler_state, comm_state,
@@ -1092,7 +1133,7 @@ class StepEngine:
                  skipped) = carry
                 margs, mkwargs, larr = xs  # [k, ...] micro-batches
                 (reports, new_vars, new_opt, zero_buf, new_scaler, new_comm,
-                 new_rng, finite) = window(
+                 new_rng, sentinels, finite) = window(
                     variables, opt_state, buf, scaler_state, comm_state, rng,
                     margs, mkwargs, larr,
                 )
@@ -1100,19 +1141,18 @@ class StepEngine:
                 return (
                     (new_vars, new_opt, zero_buf, new_scaler, new_comm,
                      new_rng, skipped),
-                    reports,
+                    (reports, sentinels),
                 )
 
-            (vars_f, opt_f, buf_f, scaler_f, comm_f, rng_f, skipped), reports = (
-                jax.lax.scan(
-                    step_body,
-                    (variables, opt_state, grad_buf, scaler_state, comm_state,
-                     rng, jnp.float32(0.0)),
-                    (margs_s, mkwargs_s, larr_s),
-                )
+            ((vars_f, opt_f, buf_f, scaler_f, comm_f, rng_f, skipped),
+             (reports, sentinels_s)) = jax.lax.scan(
+                step_body,
+                (variables, opt_state, grad_buf, scaler_state, comm_state,
+                 rng, jnp.float32(0.0)),
+                (margs_s, mkwargs_s, larr_s),
             )
             return (reports, vars_f, opt_f, buf_f, scaler_f, comm_f, rng_f,
-                    skipped)
+                    sentinels_s, skipped)
 
         if self.rules is not None:
             repl = self._repl
@@ -1124,6 +1164,7 @@ class StepEngine:
                 self._scaler_shardings(),
                 self._comm_state_shardings(),
                 repl,  # rng
+                self._sentinel_shardings(),  # stacked sentinel rows
                 repl,  # skipped count
             )
             return jax.jit(
@@ -1134,15 +1175,21 @@ class StepEngine:
     # ---------------------------- apply step --------------------------- #
 
     def apply_step(self, variables, opt_state, grad_buf, scaler_state,
-                   comm_state):
+                   comm_state, loss_val=None):
         """Compiled optimizer application: unscale → gradient transport →
         finite-check → clip → update → zero buffer → scaler update
-        (reference step() path, stoke.py:990-1040 + fp16.py:788-806)."""
+        (reference step() path, stoke.py:990-1040 + fp16.py:788-806).
+
+        ``loss_val``: boundary loss scalar for the health sentinels (None
+        — an empty jit input — when sentinels are off).  Returns an extra
+        sentinel-vector slot before ``finite`` (None when off)."""
         if self._apply_fn is None:
             self._apply_fn = self._build_apply()
+        self.dispatch_count += 1
         with xprof_span("stoke/step"):
             return self._apply_fn(
-                variables, opt_state, grad_buf, scaler_state, comm_state
+                variables, opt_state, grad_buf, scaler_state, comm_state,
+                loss_val,
             )
 
     def _apply_core(self):
@@ -1152,8 +1199,10 @@ class StepEngine:
         grad_clip = self.grad_clip
         optimizer = self.optimizer
         transport = self.transport
+        sentinels_on = self.sentinels_enabled
 
-        def _apply(variables, opt_state, grad_buf, scaler_state, comm_state):
+        def _apply(variables, opt_state, grad_buf, scaler_state, comm_state,
+                   loss_val=None):
             # host-offloaded state → HBM for the (bandwidth-bound) update;
             # out_shardings write new params / opt state back to host
             variables = self._vars_to_compute(variables)
@@ -1175,6 +1224,9 @@ class StepEngine:
             # (no CommConfig / dtype="fp32") returns grads and the empty
             # state untouched: the compiled program is unchanged.
             grads, new_comm = transport.apply(grads, comm_state)
+            # health sentinels read the unscaled post-transport gradients
+            # (pre-clip — a clipped-away spike must still be visible)
+            health_grads = grads if sentinels_on else None
             finite = tree_finite(grads) if scaled else jnp.asarray(True)
             if per_loss:
                 # any loss overflowing anywhere in the window skips the step
@@ -1212,9 +1264,26 @@ class StepEngine:
                 new_scaler = scaler_state
             new_vars = {**variables, "params": new_params}
             zero_buf = tree_zeros_like(grad_buf)
-            return new_vars, new_opt, zero_buf, new_scaler, new_comm, finite
+            # sentinel vector (ISSUE 3): a handful of scalar reductions
+            # fused into THIS program — None (empty pytree) when off, so
+            # the default-off program is bit-identical
+            sentinels = (
+                compute_sentinels(
+                    loss_val, health_grads, new_params, params, finite,
+                    new_comm,
+                )
+                if sentinels_on
+                else None
+            )
+            return (new_vars, new_opt, zero_buf, new_scaler, new_comm,
+                    sentinels, finite)
 
         return _apply
+
+    def _sentinel_shardings(self):
+        """out_shardings slot for the sentinel vector: replicated when on,
+        None (matching the empty pytree) when off."""
+        return self._repl if self.sentinels_enabled else None
 
     def _build_apply(self):
         _apply = self._apply_core()
@@ -1225,6 +1294,7 @@ class StepEngine:
                 self._grad_shardings,
                 self._scaler_shardings(),
                 self._comm_state_shardings(),
+                self._sentinel_shardings(),
                 self._repl,
             )
             return jax.jit(
@@ -1259,7 +1329,9 @@ class StepEngine:
         compiles the same math split across two dispatches.
 
         Returns (report, updated_nonparam_vars, variables, opt_state,
-        grad_buf, scaler_state, comm_state, rng, finite).
+        grad_buf, scaler_state, comm_state, rng, sentinels, finite) —
+        ``sentinels`` is the health diagnostics vector at apply boundaries
+        (None off-boundary or when sentinels are off).
         """
         key = (
             "fused",
@@ -1273,6 +1345,7 @@ class StepEngine:
                 loss_treedef, deferred_info, bool(do_apply)
             )
         self._note_dispatch_shapes(key, margs, mkwargs, loss_args_flat)
+        self.dispatch_count += 1
         if do_apply:
             with xprof_span("stoke/dispatch"):
                 return self._accum_cache[key](
@@ -1290,7 +1363,7 @@ class StepEngine:
                 loss_args_flat,
             )
         return (report, updated, new_vars, opt_state, new_buf, new_scaler,
-                comm_state, new_rng, finite)
+                comm_state, new_rng, None, finite)
 
     def _build_fused(self, loss_treedef, deferred_info, do_apply):
         accum = self._accum_core(loss_treedef, deferred_info, training=True)
@@ -1309,12 +1382,18 @@ class StepEngine:
                     larr
                 )
                 merged = {**variables, **updated}
-                new_vars, new_opt, zero_buf, new_scaler, new_comm, finite = (
-                    apply_core(merged, opt_state, new_buf, scaler_mid,
-                               comm_state)
+                loss_val = (
+                    self._report_loss(report)
+                    if self.sentinels_enabled
+                    else None
+                )
+                (new_vars, new_opt, zero_buf, new_scaler, new_comm,
+                 sentinels, finite) = apply_core(
+                    merged, opt_state, new_buf, scaler_mid, comm_state,
+                    loss_val,
                 )
                 return (report, updated, new_vars, new_opt, zero_buf,
-                        new_scaler, new_comm, new_rng, finite)
+                        new_scaler, new_comm, new_rng, sentinels, finite)
 
             if self.rules is not None:
                 repl = self._repl
@@ -1327,6 +1406,7 @@ class StepEngine:
                     self._scaler_shardings(),
                     self._comm_state_shardings(),
                     repl,  # rng
+                    self._sentinel_shardings(),
                     repl,  # finite
                 )
                 return jax.jit(
@@ -1376,4 +1456,5 @@ class StepEngine:
                 return self.loss_fn(*largs, **lkwargs)
 
             self._loss_cache[key] = _loss
+        self.dispatch_count += 1
         return self._loss_cache[key](loss_args_flat)

@@ -55,6 +55,13 @@ from stoke_tpu.parallel.sharding import make_sharding_rules, place_global_tree
 from stoke_tpu.status import StokeStatus
 from stoke_tpu.telemetry import Telemetry
 from stoke_tpu.telemetry.collectors import xprof_span
+from stoke_tpu.telemetry.health import (
+    SENTINEL_INDEX,
+    HealthHaltError,
+    HealthMonitor,
+    unpack_sentinels,
+)
+from stoke_tpu.telemetry.recorder import FlightRecorder
 from stoke_tpu.utils.printing import unrolled_print
 from stoke_tpu.utils.trees import tree_count_params
 
@@ -119,6 +126,52 @@ def _timed(phase: str):
         return wrapper
 
     return deco
+
+
+def _health_guarded(fn):
+    """Method decorator for the dispatching step paths (ISSUE 3): arms the
+    hang watchdog across the call (a wedged collective hangs the training
+    thread inside the dispatch or its result fetch — only the watchdog's
+    daemon thread can report it) and writes a post-mortem bundle when the
+    call dies on an uncaught exception.  Zero overhead without a
+    ``HealthConfig``."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        h = self._health
+        if h is None:
+            return fn(self, *args, **kwargs)
+        # deadline scaled by compile grace until the first step completes;
+        # train_steps re-arms with its per-segment step count once known
+        h.arm_watchdog()
+        try:
+            return fn(self, *args, **kwargs)
+        except HealthHaltError:
+            raise  # the halt path already dumped its bundle
+        except Exception as e:
+            # one bundle per exception (nested guarded calls — e.g. the
+            # chunked train_steps recursion — re-raise through multiple
+            # wrappers) and at most max_dumps exception bundles per run
+            # (a caller retrying a failing call must not fill the disk)
+            if (
+                h.cfg.dump_on_exception
+                and not getattr(e, "_stoke_health_dumped", False)
+                and h.note_exception_dump()
+            ):
+                try:
+                    e._stoke_health_dumped = True
+                except Exception:
+                    pass
+                h.dump(
+                    "exception",
+                    extra={"method": fn.__name__, "error": repr(e)[:500]},
+                )
+            raise
+        finally:
+            h.disarm_watchdog()
+
+    return wrapper
 
 
 class Stoke:
@@ -274,6 +327,7 @@ class Stoke:
             loss_weights=loss_weights,
             aux_loss_weight=aux_loss_weight,
             comm=st.comm_config,
+            health=st.health_config,
         )
         if self._rules is not None:
             opt_shapes = jax.eval_shape(self._optimizer.init, variables["params"])
@@ -381,6 +435,36 @@ class Stoke:
         self._engine._compile_tracker = self._telemetry.compile_tracker
         self._last_grad_norm: Optional[float] = None
 
+        # ----- health monitor (ISSUE 3: sentinels + detectors + flight
+        #       recorder + watchdog; default OFF — without a HealthConfig
+        #       the step paths are untouched) -----
+        self._health: Optional[HealthMonitor] = None
+        self._last_sentinels = None
+        hcfg = st.health_config
+        if hcfg is not None:
+            bundle_dir = hcfg.bundle_dir
+            if bundle_dir is None:
+                base = (
+                    st.telemetry_config.output_dir
+                    if st.telemetry_config is not None
+                    else "health"
+                )
+                bundle_dir = os.path.join(base, "postmortem")
+            recorder = FlightRecorder(
+                bundle_dir,
+                ring_size=hcfg.ring_size,
+                status_dict=st.to_dict(),
+                mesh_info=self._mesh_info(),
+                snapshot_fn=self._telemetry.registry.snapshot,
+                install_signal_handlers=hcfg.dump_signals,
+            )
+            self._health = HealthMonitor(
+                hcfg,
+                self._telemetry.registry,
+                recorder,
+                compile_tracker=self._telemetry.compile_tracker,
+            )
+
         # ----- wall-clock breakdown (reference wall_clock_breakdown,
         #       configs.py:540; host-side dispatch times — device work is
         #       async, use profile_trace() for device timelines).  Backed by
@@ -425,6 +509,27 @@ class Stoke:
                 )
                 return self._device
             raise
+
+    def _mesh_info(self) -> dict:
+        """Topology description for post-mortem bundles (host-side only)."""
+        try:
+            if self._mesh is None:
+                return {
+                    "mesh": None,
+                    "device": str(self._device),
+                    "n_processes": jax.process_count(),
+                }
+            return {
+                "axes": list(self._mesh.axis_names),
+                "shape": {k: int(v) for k, v in self._mesh.shape.items()},
+                "n_devices": int(self._mesh.size),
+                "device_kinds": sorted(
+                    {d.device_kind for d in self._mesh.devices.flat}
+                ),
+                "n_processes": jax.process_count(),
+            }
+        except Exception:
+            return {"mesh": "unavailable"}
 
     def _opt_materialize(self):
         """Optimizer state as device arrays (reads the disk tier if the
@@ -600,6 +705,7 @@ class Stoke:
             )
         return self._engine.train_fwd(self._variables, rng, margs, mkwargs)
 
+    @_health_guarded
     @_timed("loss")
     def loss(self, *args, **kwargs):
         """Wrapped loss (reference stoke.py:872-912).
@@ -697,6 +803,7 @@ class Stoke:
         self._grad_accum_counter += 1
         self._backward_steps += 1
 
+    @_health_guarded
     @_timed("step")
     def step(self) -> None:
         """Wrapped optimizer step (reference stoke.py:990-1040): at the
@@ -717,6 +824,7 @@ class Stoke:
             self._grad_buf,
             self._scaler_state,
             self._comm_state,
+            sentinels,
             finite,
         ) = self._engine.apply_step(
             self._variables,
@@ -724,6 +832,7 @@ class Stoke:
             self._grad_buf,
             self._scaler_state,
             self._comm_state,
+            self._health_loss_input(),
         )
         self._opt_commit(new_opt)
         if t0 is not None:
@@ -738,10 +847,12 @@ class Stoke:
         self._optimizer_steps += 1
         self._grad_accum_counter = 0
         self._reset_tracking_window()
+        self._observe_health(sentinels)
         self._maybe_log_metrics()
         self._maybe_emit_telemetry()
         self._maybe_auto_save()
 
+    @_health_guarded
     @_timed("train_step")
     def train_step(
         self,
@@ -799,6 +910,7 @@ class Stoke:
             self._scaler_state,
             self._comm_state,
             self._rng,
+            sentinels,
             finite,
         ) = self._engine.fused_step(
             self._variables,
@@ -832,6 +944,7 @@ class Stoke:
             self._optimizer_steps += 1
             self._grad_accum_counter = 0
             self._reset_tracking_window()
+            self._observe_health(sentinels)
             self._maybe_log_metrics()
             self._maybe_emit_telemetry()
             self._maybe_auto_save()
@@ -930,9 +1043,16 @@ class Stoke:
         engine._apply_core); the norm is divided by the current scale here
         so the logged value is in true-gradient units.  Per-loss mode
         (num_losses > 1) unscales into the buffer immediately, so no
-        adjustment applies."""
+        adjustment applies.
+
+        With health sentinels on this whole extra reduction is skipped:
+        the sentinel vector already carries the same norm computed inside
+        the compiled apply (``_observe_health`` installs it — ISSUE 3
+        satellite: no second reduction/dispatch)."""
         t = self._telemetry
         if not (t.enabled and t.config.grad_norm):
+            return
+        if self._engine.sentinels_enabled:
             return
         try:
             import optax
@@ -967,6 +1087,61 @@ class Stoke:
         except Exception:
             return None
 
+    # ------------------------------------------------------------------ #
+    # health monitor (ISSUE 3: sentinels / detectors / recorder / watchdog)
+    # ------------------------------------------------------------------ #
+
+    def _health_loss_input(self):
+        """Boundary loss scalar for the 4-call apply's sentinel vector
+        (None — an empty jit input — when sentinels are off, keeping the
+        compiled program bit-identical to a health-free build)."""
+        if not self._engine.sentinels_enabled:
+            return None
+        if self._last_step_loss is not None:
+            return self._last_step_loss
+        return self._zero_scalar()
+
+    def _observe_health(self, sentinels, window: int = 1) -> None:
+        """Feed the just-completed optimizer step(s) to the health monitor:
+        fetch the on-device sentinel rows (one tiny host transfer — the
+        values were computed inside the step's existing dispatch), run the
+        detector registry, and cache the latest row for the telemetry step
+        event.  A ``halt``-action detector raises
+        :class:`~stoke_tpu.telemetry.health.HealthHaltError` from inside
+        ``HealthMonitor.observe`` — i.e. at this facade boundary."""
+        h = self._health
+        if h is None:
+            return
+        rows = None
+        if sentinels is not None:
+            rows = np.asarray(jax.device_get(sentinels), np.float32)
+            if rows.ndim == 1:
+                rows = rows[None]
+            self._last_sentinels = rows[-1]
+            t = self._telemetry
+            if t.enabled and t.config.grad_norm:
+                # sentinel delegation (ISSUE 3 satellite): the in-step
+                # grad norm replaces _sample_grad_norm's host-side extra
+                # reduction — same true-gradient units (the apply core
+                # unscales before the norm)
+                gn = float(rows[-1][SENTINEL_INDEX["grad_norm"]])
+                self._last_grad_norm = gn
+                t.registry.gauge("train/grad_norm").set(gn)
+        first = self._optimizer_steps - window + 1
+        for i in range(window):
+            h.observe(first + i, rows[i] if rows is not None else None)
+
+    @property
+    def health(self) -> Optional[HealthMonitor]:
+        """The run's health monitor (None without a ``HealthConfig``)."""
+        return self._health
+
+    @property
+    def dispatch_count(self) -> int:
+        """Compiled-program invocations issued by this run's engine (the
+        health acceptance counter: sentinels must not add dispatches)."""
+        return self._engine.dispatch_count
+
     def _maybe_emit_telemetry(self, window: int = 1) -> None:
         """Assemble + emit one structured step event at the telemetry
         cadence (JSONL / Prometheus / TB sinks).  Device->host transfers
@@ -994,7 +1169,12 @@ class Stoke:
         ):
             return
         scaled = self._precision.scaled
-        t.record_step(
+        sent = (
+            unpack_sentinels(self._last_sentinels)
+            if self._last_sentinels is not None
+            else {}
+        )
+        record = t.record_step(
             self._optimizer_steps,
             window_steps=window,
             ema_loss=self.ema_loss,
@@ -1003,13 +1183,28 @@ class Stoke:
             loss_scale=self.loss_scale if scaled else None,
             skipped_steps=self.skipped_optimizer_steps if scaled else 0.0,
             comm_residual_norm=self._sample_comm_residual_norm(),
+            param_norm=sent.get("param_norm"),
+            update_ratio=sent.get("update_ratio"),
+            nonfinite_leaves=sent.get("nonfinite_leaves"),
+            health_anomalies=(
+                float(self._health.anomaly_count)
+                if self._health is not None
+                else None
+            ),
         )
+        if record is not None and self._health is not None:
+            # flight-recorder ring: the post-mortem bundle replays the
+            # last N structured step events alongside the sentinel rows
+            self._health.recorder.record_event(record)
         self._last_grad_norm = None
 
     def close_telemetry(self) -> None:
-        """Flush + close the telemetry sinks (idempotent; sinks are
-        line-buffered/atomic, so skipping this loses at most nothing)."""
+        """Flush + close the telemetry sinks and the health monitor
+        (watchdog thread + signal handlers); idempotent — sinks are
+        line-buffered/atomic, so skipping this loses at most nothing."""
         self._telemetry.close()
+        if self._health is not None:
+            self._health.close()
 
     def _maybe_auto_save(self, window: int = 1) -> None:
         """Periodic checkpoint from the step path when
@@ -1053,6 +1248,7 @@ class Stoke:
         except FileNotFoundError:
             return False
 
+    @_health_guarded
     @_timed("train_step_window")
     def train_step_window(
         self,
@@ -1109,6 +1305,7 @@ class Stoke:
             self._scaler_state,
             self._comm_state,
             self._rng,
+            sentinels,
             finite,
         ) = self._engine.window_step(
             self._variables,
@@ -1136,11 +1333,13 @@ class Stoke:
             )
         self._optimizer_steps += 1
         self._reset_tracking_window()
+        self._observe_health(sentinels)
         self._maybe_log_metrics()
         self._maybe_emit_telemetry()
         self._maybe_auto_save()
         return reports
 
+    @_health_guarded
     @_timed("train_steps")
     def train_steps(
         self,
@@ -1280,6 +1479,10 @@ class Stoke:
         deferred_info = tuple(
             (i, l._path) for i, l in enumerate(flat) if is_deferred(l)
         )
+        if self._health is not None:
+            # one dispatch legitimately covers n optimizer steps: re-arm
+            # the watchdog with the per-segment deadline (n x timeout)
+            self._health.arm_watchdog(steps=n)
         (
             reports,
             self._variables,
@@ -1288,6 +1491,7 @@ class Stoke:
             self._scaler_state,
             self._comm_state,
             self._rng,
+            sentinels,
             skipped,
         ) = self._engine.multi_step(
             self._variables,
@@ -1319,6 +1523,7 @@ class Stoke:
         if self._precision.scaled:
             self._skipped_steps = self._skipped_steps + skipped
         self._optimizer_steps += n
+        self._observe_health(sentinels, window=n)
         self._maybe_log_metrics(window=n)
         self._maybe_emit_telemetry(window=n)
         self._maybe_auto_save(window=n)

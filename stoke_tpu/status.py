@@ -26,8 +26,10 @@ from stoke_tpu.configs import (
     ALL_CONFIG_CLASSES,
     COMM_DTYPES,
     COMM_STRATEGIES,
+    HEALTH_ACTIONS,
     ActivationCheckpointingConfig,
     CheckpointConfig,
+    HealthConfig,
     ClipGradConfig,
     ClipGradNormConfig,
     CommConfig,
@@ -435,6 +437,60 @@ class StokeStatus:
                 )
             return False
 
+        def _health_invalid(s):
+            """Health-monitor legality (ISSUE 3): sentinels ride the
+            telemetry pipeline (their values surface in the JSONL step
+            events), halting on non-finite gradients conflicts with fp16's
+            skip-on-overflow scaler (transient infs are its normal
+            operation), and a watchdog without a positive timeout would
+            either never fire or fire immediately."""
+            cfg = self._configs.get("HealthConfig")
+            if cfg is None:
+                return False
+            if cfg.sentinels and "TelemetryConfig" not in self._configs:
+                return (
+                    "HealthConfig(sentinels=True) requires a TelemetryConfig"
+                    " — the sentinel values surface through the telemetry "
+                    "step events; add one or set sentinels=False"
+                )
+            if cfg.ring_size < 1:
+                return (
+                    f"HealthConfig.ring_size must be >= 1, got "
+                    f"{cfg.ring_size}"
+                )
+            if cfg.detector_warmup_steps < 1:
+                return (
+                    f"HealthConfig.detector_warmup_steps must be >= 1, got "
+                    f"{cfg.detector_warmup_steps}"
+                )
+            for field in (
+                "loss_spike_action", "grad_spike_action", "nonfinite_action",
+                "scaler_skip_action", "recompile_storm_action",
+                "starvation_action", "comm_residual_action",
+            ):
+                action = getattr(cfg, field)
+                if action not in HEALTH_ACTIONS:
+                    return (
+                        f"HealthConfig.{field} {action!r} unknown; valid: "
+                        f"{list(HEALTH_ACTIONS)}"
+                    )
+            if (
+                cfg.nonfinite_action == "halt"
+                and s["precision"] is PrecisionOptions.fp16
+            ):
+                return (
+                    "HealthConfig(nonfinite_action='halt') is incompatible "
+                    "with precision='fp16' — the dynamic loss scaler "
+                    "tolerates transient infs by skipping the step; use "
+                    "'record'/'warn'/'dump', or bf16/full precision"
+                )
+            if cfg.watchdog and cfg.watchdog_timeout_s <= 0:
+                return (
+                    f"HealthConfig.watchdog requires watchdog_timeout_s > 0,"
+                    f" got {cfg.watchdog_timeout_s}"
+                )
+            return False
+
         def _offload_cpu_no_fallback(s):
             for name in ("OffloadOptimizerConfig", "OffloadParamsConfig"):
                 cfg = self._configs.get(name)
@@ -559,6 +615,10 @@ class StokeStatus:
             (
                 _comm_invalid,
                 "CommConfig is invalid for this combination",
+            ),
+            (
+                _health_invalid,
+                "HealthConfig is invalid for this combination",
             ),
             (
                 _offload_cpu_no_fallback,
@@ -771,6 +831,12 @@ class StokeStatus:
         """None unless explicitly supplied (metrics logging is opt-in,
         reference configs.py:392-405)."""
         return self._configs.get("TensorboardConfig")
+
+    @property
+    def health_config(self) -> Optional[HealthConfig]:
+        """None unless explicitly supplied (the health monitor is opt-in;
+        without it the step paths are bit-identical to pre-ISSUE-3)."""
+        return self._configs.get("HealthConfig")
 
     @property
     def telemetry_config(self) -> Optional[TelemetryConfig]:

@@ -43,6 +43,17 @@ from stoke_tpu.telemetry.events import (
     read_step_events,
     validate_step_event,
 )
+from stoke_tpu.telemetry.health import (
+    SENTINEL_FIELDS,
+    WATCHDOG_EXIT_CODE,
+    Anomaly,
+    HangWatchdog,
+    HealthHaltError,
+    HealthMonitor,
+    compute_sentinels,
+    unpack_sentinels,
+)
+from stoke_tpu.telemetry.recorder import FlightRecorder
 from stoke_tpu.telemetry.registry import (
     Counter,
     Gauge,
@@ -77,6 +88,16 @@ __all__ = [
     "build_step_event",
     "validate_step_event",
     "read_step_events",
+    # health monitor (ISSUE 3)
+    "SENTINEL_FIELDS",
+    "WATCHDOG_EXIT_CODE",
+    "Anomaly",
+    "HangWatchdog",
+    "HealthHaltError",
+    "HealthMonitor",
+    "FlightRecorder",
+    "compute_sentinels",
+    "unpack_sentinels",
 ]
 
 
@@ -231,6 +252,10 @@ class Telemetry:
         loss_scale=None,
         skipped_steps: float = 0.0,
         comm_residual_norm: Optional[float] = None,
+        param_norm: Optional[float] = None,
+        update_ratio: Optional[float] = None,
+        nonfinite_leaves: Optional[float] = None,
+        health_anomalies: Optional[float] = None,
         tokens_hint: Optional[float] = None,
         ts: Optional[float] = None,
     ) -> Optional[dict]:
@@ -312,6 +337,10 @@ class Telemetry:
             comm_bytes_onwire=comm_wire,
             comm_compression=comm_ratio,
             comm_residual_norm=comm_residual_norm,
+            param_norm=param_norm,
+            update_ratio=update_ratio,
+            nonfinite_leaves=nonfinite_leaves,
+            health_anomalies=health_anomalies,
             compiles_total=compiles,
             recompiles=recompiles,
             compile_time_s=compile_time,

@@ -73,6 +73,16 @@ STEP_EVENT_FIELDS: Dict[str, tuple] = {
     "comm_bytes_onwire": (False, "nullable_number"),
     "comm_compression": (False, "nullable_number"),
     "comm_residual_norm": (False, "nullable_number"),
+    # health sentinels (ISSUE 3; null without a HealthConfig): per-step
+    # diagnostics computed inside the compiled step — param_norm is the
+    # global norm of the updated parameters, update_ratio the step's
+    # ||delta param|| / ||param||, nonfinite_leaves the count of gradient
+    # leaves carrying any non-finite value; health_anomalies is the
+    # cumulative detector-firing count
+    "param_norm": (False, "nullable_number"),
+    "update_ratio": (False, "nullable_number"),
+    "nonfinite_leaves": (False, "nullable_number"),
+    "health_anomalies": (False, "nullable_number"),
     "hbm_bytes_in_use": (False, "nullable_number"),
     "hbm_peak_bytes": (False, "nullable_number"),
     "hbm_bytes_limit": (False, "nullable_number"),
@@ -175,6 +185,10 @@ def build_step_event(
     comm_bytes_onwire: Optional[float] = None,
     comm_compression: Optional[float] = None,
     comm_residual_norm: Optional[float] = None,
+    param_norm: Optional[float] = None,
+    update_ratio: Optional[float] = None,
+    nonfinite_leaves: Optional[float] = None,
+    health_anomalies: Optional[float] = None,
     hbm_bytes_in_use: Optional[int] = None,
     hbm_peak_bytes: Optional[int] = None,
     hbm_bytes_limit: Optional[int] = None,
@@ -214,6 +228,14 @@ def build_step_event(
         ),
         "comm_compression": _round(comm_compression, 4),
         "comm_residual_norm": _round(comm_residual_norm),
+        "param_norm": _round(param_norm),
+        "update_ratio": _round(update_ratio, 8),
+        "nonfinite_leaves": (
+            None if nonfinite_leaves is None else float(nonfinite_leaves)
+        ),
+        "health_anomalies": (
+            None if health_anomalies is None else float(health_anomalies)
+        ),
         "hbm_bytes_in_use": hbm_bytes_in_use,
         "hbm_peak_bytes": hbm_peak_bytes,
         "hbm_bytes_limit": hbm_bytes_limit,

@@ -1,0 +1,748 @@
+"""Training health monitor (ISSUE 3 tentpole): on-device numerics
+sentinels, host-side anomaly detectors, and the hang watchdog.
+
+The telemetry layer answers "how fast is the step"; this module answers
+"is this run still healthy".  Three cooperating pieces (the fourth, the
+flight recorder, lives in :mod:`stoke_tpu.telemetry.recorder`):
+
+- **Sentinels** — :func:`compute_sentinels` packs per-step diagnostics
+  (loss, global grad/param norms, update ratio, nonfinite-leaf count,
+  scaler-skip flag, comm residual norm) into one tiny f32 vector *inside*
+  the engine's existing compiled apply, so surfacing them costs zero extra
+  device dispatches (acceptance-checked against the engine dispatch
+  counter).  This subsumes the host-side ``facade._sample_grad_norm``
+  extra reduction.
+- **Detectors** — small host-side checks over the sentinel stream and the
+  telemetry registry (loss/grad-norm spike z-score vs a running EMA,
+  nonfinite gradients, fp16 scaler-skip streaks, recompile storms, loader
+  starvation streaks, error-feedback residual runaway), each firing one of
+  four actions: ``record`` / ``warn`` / ``dump`` / ``halt``.
+- **Watchdog** — :class:`HangWatchdog`, a daemon thread armed per dispatch
+  that fires when no step completes within the timeout (wedged collective
+  / dead tunnel), dumping all-thread stacks + a post-mortem bundle and
+  optionally hard-exiting with :data:`WATCHDOG_EXIT_CODE`.
+
+Everything is default-OFF; with no ``HealthConfig`` the compiled step
+programs are bit-identical to before this module existed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+#: exit code of a watchdog-killed process — distinct from generic failures
+#: so supervisors (scripts/_supervise.py keeps a synced copy: it must not
+#: import jax) can report "hung and self-terminated" instead of "timed out"
+WATCHDOG_EXIT_CODE = 113
+
+#: sentinel vector layout: field name -> index.  The order is the wire
+#: format of the packed vector the compiled step returns; never reorder,
+#: only append.
+SENTINEL_FIELDS = (
+    "step_loss",          # undivided micro loss at the boundary
+    "grad_norm",          # global grad norm, unscaled, post-transport, pre-clip
+    "param_norm",         # global norm of the updated parameters
+    "update_ratio",       # ||param_new - param_old|| / (||param_new|| + eps)
+    "nonfinite_leaves",   # gradient leaves containing any non-finite value
+    "scaler_skip",        # 1.0 when the fp16 scaler skipped this step
+    "comm_residual_norm", # error-feedback residual norm (0 without EF)
+)
+SENTINEL_INDEX = {name: i for i, name in enumerate(SENTINEL_FIELDS)}
+N_SENTINELS = len(SENTINEL_FIELDS)
+
+
+class HealthHaltError(RuntimeError):
+    """Raised at the facade boundary when a detector with action ``halt``
+    fires.  Carries the anomalies that tripped it and the post-mortem
+    bundle path (a halt always dumps first — leave a corpse)."""
+
+    def __init__(self, anomalies: List["Anomaly"], bundle: Optional[str]):
+        self.anomalies = list(anomalies)
+        self.bundle = bundle
+        names = ", ".join(a.detector for a in self.anomalies) or "?"
+        msg = f"Stoke -- health halt: {names}"
+        if bundle:
+            msg += f" (post-mortem bundle: {bundle})"
+        super().__init__(msg)
+
+
+# --------------------------------------------------------------------------- #
+# on-device sentinels (called inside the engine's compiled apply)
+# --------------------------------------------------------------------------- #
+
+
+def compute_sentinels(loss_val, grads, new_params, old_params, finite,
+                      comm_state):
+    """Pack the per-step diagnostics vector — TRACED inside the engine's
+    apply core, so every value is one fused reduction in the existing XLA
+    program (zero extra dispatches, zero extra host syncs beyond fetching
+    the tiny output).
+
+    Args mirror what the apply core already has in hand: the boundary loss
+    scalar (or None), the unscaled post-transport gradients, the parameter
+    trees before/after the update, the scaler finite flag, and the
+    gradient-transport state (``residual`` key when error feedback is on).
+    Returns a ``[N_SENTINELS]`` float32 array.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    eps = jnp.float32(1e-12)
+    grad_norm = optax.global_norm(grads).astype(jnp.float32)
+    param_norm = optax.global_norm(new_params).astype(jnp.float32)
+    update_norm = optax.global_norm(
+        jax.tree_util.tree_map(
+            lambda a, b: a.astype(jnp.float32) - b.astype(jnp.float32),
+            new_params, old_params,
+        )
+    )
+    update_ratio = update_norm / (param_norm + eps)
+    leaves = jax.tree_util.tree_leaves(grads)
+    if leaves:
+        nonfinite = sum(
+            jnp.any(~jnp.isfinite(l)).astype(jnp.float32) for l in leaves
+        )
+    else:
+        nonfinite = jnp.float32(0.0)
+    skip = 1.0 - jnp.asarray(finite).astype(jnp.float32)
+    residual = None
+    if isinstance(comm_state, dict):
+        residual = comm_state.get("residual")
+    res_norm = (
+        optax.global_norm(residual).astype(jnp.float32)
+        if residual is not None
+        else jnp.float32(0.0)
+    )
+    loss = (
+        jnp.asarray(loss_val, jnp.float32).reshape(())
+        if loss_val is not None
+        else jnp.float32(jnp.nan)
+    )
+    return jnp.stack([
+        loss, grad_norm, param_norm, update_ratio,
+        jnp.asarray(nonfinite, jnp.float32), skip, res_norm,
+    ])
+
+
+def unpack_sentinels(vec) -> Dict[str, float]:
+    """Host-side view of one sentinel row as ``{field: float}``."""
+    arr = np.asarray(vec, np.float64).reshape(-1)
+    return {name: float(arr[i]) for i, name in enumerate(SENTINEL_FIELDS)}
+
+
+# --------------------------------------------------------------------------- #
+# detectors
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class Anomaly:
+    """One detector firing."""
+
+    detector: str
+    step: int
+    action: str
+    message: str
+    value: Optional[float] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "detector": self.detector,
+            "step": self.step,
+            "action": self.action,
+            "message": self.message,
+            "value": self.value,
+        }
+
+
+class _RunningStats:
+    """EMA mean/variance for the z-score spike detectors (an exponentially
+    weighted analogue of Welford's update — deterministic, O(1) state)."""
+
+    def __init__(self, alpha: float):
+        self.alpha = float(alpha)
+        self.mean: Optional[float] = None
+        self.var = 0.0
+        self.count = 0
+
+    def zscore(self, x: float) -> Optional[float]:
+        """Z-score of ``x`` against the CURRENT stats (before updating with
+        it); None until the first observation."""
+        if self.mean is None:
+            return None
+        std = self.var ** 0.5
+        if std <= 0.0:
+            return 0.0 if x == self.mean else float("inf")
+        return (x - self.mean) / std
+
+    def update(self, x: float) -> None:
+        self.count += 1
+        if self.mean is None:
+            self.mean = float(x)
+            self.var = 0.0
+            return
+        a = self.alpha
+        delta = float(x) - self.mean
+        self.mean += a * delta
+        # EW variance: blends the squared innovation (West 1979 lineage)
+        self.var = (1.0 - a) * (self.var + a * delta * delta)
+
+
+class Detector:
+    """Base: ``check(step, sentinels, ctx)`` returns an :class:`Anomaly`
+    or None.  ``sentinels`` is the unpacked dict (or None when the
+    on-device vector is off); ``ctx`` is the owning monitor (registry /
+    compile-tracker access)."""
+
+    name = "detector"
+
+    def __init__(self, action: str):
+        self.action = action
+
+    def check(self, step: int, sentinels: Optional[Dict[str, float]],
+              ctx: "HealthMonitor") -> Optional[Anomaly]:
+        raise NotImplementedError
+
+    def _fire(self, step: int, message: str,
+              value: Optional[float] = None) -> Anomaly:
+        return Anomaly(self.name, step, self.action, message, value)
+
+
+class SpikeDetector(Detector):
+    """Shared z-score-vs-EMA spike logic for loss / grad-norm."""
+
+    field_name = ""
+
+    def __init__(self, action: str, zscore: float, warmup: int, alpha: float):
+        super().__init__(action)
+        self.threshold = float(zscore)
+        self.warmup = int(warmup)
+        self.stats = _RunningStats(alpha)
+
+    def check(self, step, sentinels, ctx):
+        if sentinels is None:
+            return None
+        x = sentinels.get(self.field_name)
+        if x is None or not np.isfinite(x):
+            # non-finite values are the NonFiniteDetector's job; feeding
+            # them into the EMA would poison the baseline forever
+            return None
+        z = self.stats.zscore(x)
+        fired = None
+        if (
+            z is not None
+            and self.stats.count >= self.warmup
+            and z > self.threshold
+        ):
+            fired = self._fire(
+                step,
+                f"{self.field_name} {x:.6g} is {z:.1f} sigma above its "
+                f"running mean {self.stats.mean:.6g} "
+                f"(threshold {self.threshold})",
+                value=x,
+            )
+            # a spike must not drag the baseline up to itself: clamp the
+            # update to the detection threshold so repeated spikes keep
+            # firing instead of normalizing.  With ZERO running variance
+            # the clamp would collapse to the mean and a permanent regime
+            # shift would fire forever — feed the raw value there so the
+            # baseline adapts.
+            std = self.stats.var ** 0.5
+            if std > 0:
+                x = self.stats.mean + self.threshold * std
+        self.stats.update(x)
+        return fired
+
+
+class LossSpikeDetector(SpikeDetector):
+    name = "loss_spike"
+    field_name = "step_loss"
+
+
+class GradNormSpikeDetector(SpikeDetector):
+    name = "grad_norm_spike"
+    field_name = "grad_norm"
+
+
+class NonFiniteDetector(Detector):
+    name = "nonfinite_grads"
+
+    def check(self, step, sentinels, ctx):
+        if sentinels is None:
+            return None
+        n = sentinels.get("nonfinite_leaves", 0.0)
+        if n and n > 0:
+            return self._fire(
+                step,
+                f"{int(n)} gradient leaves contain non-finite values at "
+                f"step {step}",
+                value=n,
+            )
+        return None
+
+
+class ScalerSkipStreakDetector(Detector):
+    name = "scaler_skip_streak"
+
+    def __init__(self, action: str, streak: int):
+        super().__init__(action)
+        self.streak = int(streak)
+        self._run = 0
+
+    def check(self, step, sentinels, ctx):
+        if sentinels is None:
+            return None
+        if sentinels.get("scaler_skip", 0.0) > 0:
+            self._run += 1
+        else:
+            self._run = 0
+            return None
+        if self._run >= self.streak:
+            fired = self._fire(
+                step,
+                f"{self._run} consecutive fp16 scaler-skipped steps "
+                f"(scale collapse?)",
+                value=float(self._run),
+            )
+            self._run = 0  # re-arm: fire once per streak, not per step
+            return fired
+        return None
+
+
+class RecompileStormDetector(Detector):
+    """Structural recompiles (engine shape-signature collector) growing by
+    >= threshold within a sliding step window: shape-polymorphic inputs
+    eating the run in silent multi-second compiles."""
+
+    name = "recompile_storm"
+
+    def __init__(self, action: str, threshold: int, window: int):
+        super().__init__(action)
+        self.threshold = int(threshold)
+        self.window = int(window)
+        self._history: List[tuple] = []  # (step, cumulative recompiles)
+
+    def check(self, step, sentinels, ctx):
+        tracker = ctx.compile_tracker
+        if tracker is None:
+            return None
+        total = tracker.recompiles
+        self._history.append((step, total))
+        cutoff = step - self.window
+        while self._history and self._history[0][0] < cutoff:
+            self._history.pop(0)
+        delta = total - self._history[0][1]
+        if delta >= self.threshold:
+            self._history = [(step, total)]  # re-arm
+            return self._fire(
+                step,
+                f"{delta} structural recompiles within the last "
+                f"{self.window} steps (shape-polymorphic inputs?)",
+                value=float(delta),
+            )
+        return None
+
+
+class LoaderStarvationDetector(Detector):
+    """Consecutive steps accruing post-warmup loader starvation time: the
+    device is waiting on the input pipeline."""
+
+    name = "loader_starvation"
+
+    def __init__(self, action: str, streak: int):
+        super().__init__(action)
+        self.streak = int(streak)
+        self._last = 0.0
+        self._run = 0
+
+    def check(self, step, sentinels, ctx):
+        counter = ctx.registry.get("data/starvation_s")
+        if counter is None:
+            return None
+        now = counter.value
+        grew = now > self._last
+        self._last = now
+        if grew:
+            self._run += 1
+        else:
+            self._run = 0
+            return None
+        if self._run >= self.streak:
+            fired = self._fire(
+                step,
+                f"loader starvation accrued on {self._run} consecutive "
+                f"steps ({now:.3f}s total; input-pipeline-bound)",
+                value=now,
+            )
+            self._run = 0
+            return fired
+        return None
+
+
+class CommResidualRunawayDetector(Detector):
+    """Error-feedback residual norm outrunning its own EMA (or going
+    non-finite): the int8 transport's quantization error is no longer being
+    re-absorbed — the standing correctness monitor PR 2's lossy wire format
+    requires."""
+
+    name = "comm_residual_runaway"
+
+    def __init__(self, action: str, factor: float, warmup: int, alpha: float):
+        super().__init__(action)
+        self.factor = float(factor)
+        self.warmup = int(warmup)
+        self.stats = _RunningStats(alpha)
+
+    def check(self, step, sentinels, ctx):
+        if sentinels is None:
+            return None
+        x = sentinels.get("comm_residual_norm", 0.0)
+        if x == 0.0:
+            return None  # no transport / no error feedback
+        if not np.isfinite(x):
+            return self._fire(
+                step, "error-feedback residual went non-finite", value=x
+            )
+        fired = None
+        if (
+            self.stats.mean is not None
+            and self.stats.count >= self.warmup
+            and self.stats.mean > 0
+            and x > self.factor * self.stats.mean
+        ):
+            fired = self._fire(
+                step,
+                f"error-feedback residual norm {x:.6g} exceeds "
+                f"{self.factor}x its running mean {self.stats.mean:.6g} "
+                f"(quantization error outrunning re-injection)",
+                value=x,
+            )
+        self.stats.update(x)
+        return fired
+
+
+def build_detectors(cfg) -> List[Detector]:
+    """Instantiate the detector registry from a ``HealthConfig``."""
+    return [
+        LossSpikeDetector(
+            cfg.loss_spike_action, cfg.loss_spike_zscore,
+            cfg.detector_warmup_steps, cfg.ema_alpha,
+        ),
+        GradNormSpikeDetector(
+            cfg.grad_spike_action, cfg.grad_spike_zscore,
+            cfg.detector_warmup_steps, cfg.ema_alpha,
+        ),
+        NonFiniteDetector(cfg.nonfinite_action),
+        ScalerSkipStreakDetector(
+            cfg.scaler_skip_action, cfg.scaler_skip_streak
+        ),
+        RecompileStormDetector(
+            cfg.recompile_storm_action, cfg.recompile_storm_threshold,
+            cfg.recompile_storm_window,
+        ),
+        LoaderStarvationDetector(
+            cfg.starvation_action, cfg.starvation_streak
+        ),
+        CommResidualRunawayDetector(
+            cfg.comm_residual_action, cfg.comm_residual_factor,
+            cfg.detector_warmup_steps, cfg.ema_alpha,
+        ),
+    ]
+
+
+# --------------------------------------------------------------------------- #
+# hang watchdog
+# --------------------------------------------------------------------------- #
+
+
+class HangWatchdog:
+    """Daemon thread firing when an armed dispatch does not complete in
+    time (the wedged-collective / dead-tunnel case: the training thread is
+    stuck inside a device call and can never report the hang itself).
+
+    ``arm()`` before a dispatch, ``disarm()`` once the step (and its
+    sentinel fetch) completed.  On trip: ``on_trip()`` runs on the watchdog
+    thread (dump stacks + bundle), then — with ``kill=True`` — the process
+    hard-exits with :data:`WATCHDOG_EXIT_CODE` so a supervisor can tell
+    "hung and self-terminated" from a generic timeout.  Fires once per arm.
+    """
+
+    def __init__(
+        self,
+        timeout_s: float,
+        on_trip: Callable[[], None],
+        *,
+        kill: bool = False,
+        exit_code: int = WATCHDOG_EXIT_CODE,
+    ):
+        self.timeout_s = float(timeout_s)
+        self.on_trip = on_trip
+        self.kill = bool(kill)
+        self.exit_code = int(exit_code)
+        self.trips = 0
+        self._deadline: Optional[float] = None
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, name="stoke-health-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def arm(self, timeout_s: Optional[float] = None) -> None:
+        """Arm (or re-arm, extending the deadline) for one dispatch;
+        ``timeout_s`` overrides the default — callers scale it by the
+        steps a dispatch covers and by warm-up compile grace."""
+        with self._lock:
+            self._deadline = time.monotonic() + (
+                self.timeout_s if timeout_s is None else float(timeout_s)
+            )
+        self._wake.set()
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._deadline = None
+
+    def stop(self) -> None:
+        self._stop = True
+        self._wake.set()
+        self._thread.join(timeout=2.0)
+
+    def _loop(self) -> None:
+        while not self._stop:
+            with self._lock:
+                deadline = self._deadline
+            if deadline is None:
+                self._wake.wait(timeout=self.timeout_s)
+                self._wake.clear()
+                continue
+            wait = deadline - time.monotonic()
+            if wait > 0:
+                # short slices so a disarm/stop is honored promptly
+                self._wake.wait(timeout=min(wait, 0.05))
+                self._wake.clear()
+                continue
+            with self._lock:
+                # re-check under the lock: the step may have completed (or
+                # re-armed) while we were deciding to fire
+                if self._deadline is None or self._deadline > time.monotonic():
+                    continue
+                self._deadline = None  # fire once per arm
+            self.trips += 1
+            try:
+                self.on_trip()
+            except Exception:
+                pass
+            if self.kill:
+                import os
+
+                os._exit(self.exit_code)
+
+
+# --------------------------------------------------------------------------- #
+# the monitor
+# --------------------------------------------------------------------------- #
+
+#: warnings per detector before the "warn" action degrades to "record"
+#: (a detector firing every step must not drown the log)
+MAX_WARNINGS_PER_DETECTOR = 5
+
+#: Anomaly OBJECTS retained for inspection (counters are unbounded; the
+#: object list must not grow without bound over a multi-day run with a
+#: permanently-firing detector)
+RECENT_ANOMALIES_MAX = 1024
+
+
+class HealthMonitor:
+    """Owns the detector registry, the flight recorder, and the watchdog;
+    the facade calls :meth:`observe` once per completed optimizer step.
+
+    Anomaly counters land in the telemetry registry
+    (``health/anomalies_total``, ``health/anomaly_<detector>_total``,
+    ``health/bundles_total``, ``health/watchdog_trips_total``) and are
+    therefore exposed through the Prometheus/JSONL sinks for free.
+    """
+
+    def __init__(self, cfg, registry, recorder, *,
+                 compile_tracker=None):
+        self.cfg = cfg
+        self.registry = registry
+        self.recorder = recorder
+        self.compile_tracker = compile_tracker
+        self.detectors = build_detectors(cfg)
+        # bounded recent-anomaly window; totals live in the int counters
+        # below (and the registry), never in list length
+        self.anomalies: "deque[Anomaly]" = deque(maxlen=RECENT_ANOMALIES_MAX)
+        self._anomaly_total = 0
+        self._by_detector: Dict[str, int] = {}
+        self._anomaly_dumps = 0
+        self._exception_dumps = 0
+        self._warned: Dict[str, int] = {}
+        self._steps_completed = False
+        self.watchdog: Optional[HangWatchdog] = None
+        if cfg.watchdog:
+            self.watchdog = HangWatchdog(
+                cfg.watchdog_timeout_s,
+                self._on_watchdog_trip,
+                kill=cfg.watchdog_kill,
+            )
+        # pre-register so scrapes carry zeros before the first anomaly
+        registry.counter(
+            "health/anomalies_total", help="health detector firings"
+        )
+        registry.counter(
+            "health/bundles_total", help="post-mortem bundles written"
+        )
+        registry.counter(
+            "health/watchdog_trips_total", help="hang-watchdog firings"
+        )
+
+    # ------------------------------ hooks ------------------------------ #
+
+    def arm_watchdog(self, steps: int = 1) -> None:
+        """Arm the hang watchdog for one upcoming dispatch.  The deadline
+        scales with the optimizer steps the dispatch covers (a
+        ``train_steps(n)`` segment legitimately runs n steps in one
+        program) and, until the FIRST step has ever completed, by the
+        compile-grace allowance (warm-up XLA compilation can dwarf a
+        steady-state step).  No-op without a watchdog."""
+        if self.watchdog is None:
+            return
+        timeout = self.cfg.watchdog_timeout_s * max(1, int(steps))
+        if not self._steps_completed:
+            timeout += max(0.0, self.cfg.watchdog_compile_grace_s)
+        self.watchdog.arm(timeout)
+
+    def disarm_watchdog(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.disarm()
+
+    def _on_watchdog_trip(self) -> None:
+        self.registry.counter("health/watchdog_trips_total").inc()
+        self.recorder.record("note", {
+            "note": "watchdog trip",
+            "timeout_s": self.cfg.watchdog_timeout_s,
+        })
+        self.dump(
+            "watchdog",
+            extra={
+                "timeout_s": self.cfg.watchdog_timeout_s,
+                "exit_code": (
+                    WATCHDOG_EXIT_CODE if self.cfg.watchdog_kill else None
+                ),
+            },
+        )
+
+    def dump(self, reason: str, extra: Optional[Dict[str, Any]] = None) -> str:
+        """The single bundle-writing funnel (anomaly/halt/watchdog/
+        exception/manual): counts into ``health/bundles_total`` and
+        delegates to the recorder.  Uncapped — only the anomaly ``dump``
+        action applies the ``max_dumps`` budget, in ``observe``.  (Signal
+        dumps go straight through the recorder's handler and skip the
+        counter: the handler must stay registry-free to be
+        deadlock-safe.)"""
+        self.registry.counter("health/bundles_total").inc()
+        return self.recorder.dump(reason, extra)
+
+    def close(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        self.recorder.uninstall_signal_handlers()
+
+    @property
+    def anomaly_count(self) -> int:
+        """Cumulative detector firings (NOT bounded by the retained-object
+        window)."""
+        return self._anomaly_total
+
+    def anomaly_counts_by_detector(self) -> Dict[str, int]:
+        return dict(self._by_detector)
+
+    def note_exception_dump(self) -> bool:
+        """Budget gate for exception-path bundles: True while under the
+        ``max_dumps`` cap (a caller retrying a failing call in a loop must
+        not fill the disk with identical corpses)."""
+        if self._exception_dumps >= max(1, self.cfg.max_dumps):
+            return False
+        self._exception_dumps += 1
+        return True
+
+    # ----------------------------- observe ----------------------------- #
+
+    def observe(self, step: int,
+                sentinel_row: Optional[np.ndarray]) -> List[Anomaly]:
+        """Run every detector against one completed optimizer step.
+
+        ``sentinel_row`` is the fetched on-device vector (None when
+        sentinels are off — registry-driven detectors still run).  Applies
+        each firing's action; a ``halt`` firing raises
+        :class:`HealthHaltError` after all detectors ran and the bundle was
+        written (the facade calls this at its step boundary, so the raise
+        IS the facade-boundary halt).
+        """
+        self._steps_completed = True  # un-gates the watchdog compile grace
+        sentinels = (
+            unpack_sentinels(sentinel_row)
+            if sentinel_row is not None else None
+        )
+        if sentinels is not None:
+            self.recorder.record(
+                "sentinels", {"step": step, "values": sentinels}
+            )
+        fired: List[Anomaly] = []
+        for det in self.detectors:
+            try:
+                anomaly = det.check(step, sentinels, self)
+            except Exception as e:  # a broken detector must not kill a run
+                warnings.warn(
+                    f"Stoke -- health detector {det.name} raised {e!r}; "
+                    f"skipping it this step"
+                )
+                continue
+            if anomaly is not None:
+                fired.append(anomaly)
+        if not fired:
+            return fired
+        halts: List[Anomaly] = []
+        bundle: Optional[str] = None
+        for anomaly in fired:
+            self.anomalies.append(anomaly)
+            self._anomaly_total += 1
+            self._by_detector[anomaly.detector] = (
+                self._by_detector.get(anomaly.detector, 0) + 1
+            )
+            self.registry.counter("health/anomalies_total").inc()
+            self.registry.counter(
+                f"health/anomaly_{anomaly.detector}_total",
+                help=f"{anomaly.detector} detector firings",
+            ).inc()
+            self.recorder.record("anomaly", anomaly.to_dict())
+            if anomaly.action == "warn":
+                n = self._warned.get(anomaly.detector, 0)
+                if n < MAX_WARNINGS_PER_DETECTOR:
+                    self._warned[anomaly.detector] = n + 1
+                    warnings.warn(f"Stoke -- health: {anomaly.message}")
+            elif anomaly.action == "dump":
+                if self._anomaly_dumps < self.cfg.max_dumps:
+                    self._anomaly_dumps += 1
+                    bundle = self.dump(
+                        f"anomaly-{anomaly.detector}",
+                        extra=anomaly.to_dict(),
+                    )
+            elif anomaly.action == "halt":
+                halts.append(anomaly)
+        if halts:
+            bundle = self.dump(
+                f"halt-{halts[0].detector}",
+                extra=[a.to_dict() for a in halts],
+            )
+            raise HealthHaltError(halts, bundle)
+        return fired

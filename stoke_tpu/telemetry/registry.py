@@ -261,8 +261,20 @@ class MetricsRegistry:
 
     def snapshot(self) -> Dict[str, dict]:
         """Point-in-time dump every sink renders from:
-        ``{name: {kind, value|count/sum/ema/min/max/buckets, help}}``."""
-        with self._lock:
+        ``{name: {kind, value|count/sum/ema/min/max/buckets, help}}``.
+
+        The lock acquire is bounded: the flight recorder calls this from a
+        signal handler running ON the main thread, which may have been
+        interrupted while holding the lock — blocking would deadlock the
+        crash dump.  On timeout, fall back to a lockless list() of the
+        instrument dict (atomic enough under the GIL; instruments are
+        never removed)."""
+        if self._lock.acquire(timeout=1.0):
+            try:
+                instruments = list(self._instruments.values())
+            finally:
+                self._lock.release()
+        else:  # pragma: no cover - signal-context fallback
             instruments = list(self._instruments.values())
         out: Dict[str, dict] = {}
         for inst in instruments:

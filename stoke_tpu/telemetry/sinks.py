@@ -27,6 +27,7 @@ class Sink:
 
     def __init__(self):
         self._dead = False
+        self._warned_invalid = False
 
     def emit(self, record: dict, snapshot: Dict[str, dict]) -> None:
         if self._dead:
@@ -39,6 +40,17 @@ class Sink:
                 f"Stoke -- telemetry sink {type(self).__name__} disabled "
                 f"after IO error: {e}"
             )
+        except ValueError as e:
+            # a record failing schema validation (validate_step_event names
+            # the offending key in its message) must not raise into the
+            # training loop: drop the record, warn ONCE, and keep the sink
+            # alive — later valid records still flow
+            if not self._warned_invalid:
+                self._warned_invalid = True
+                warnings.warn(
+                    f"Stoke -- telemetry sink {type(self).__name__} dropped "
+                    f"an invalid step event (further drops are silent): {e}"
+                )
 
     def _emit(self, record: dict, snapshot: Dict[str, dict]) -> None:
         raise NotImplementedError

@@ -1,0 +1,663 @@
+"""Training-health-monitor tests (ISSUE 3): on-device sentinels with zero
+extra dispatches, detector math, NaN detection + post-mortem bundles, halt
+propagation, ring-buffer bounds, the hang watchdog, and the status rules.
+
+All CPU-only and deterministic on the 8-device simulated mesh (conftest).
+"""
+
+import json
+import os
+import time
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from stoke_tpu import (
+    HealthConfig,
+    HealthHaltError,
+    Stoke,
+    StokeOptimizer,
+    StokeStatus,
+    StokeValidationError,
+    TelemetryConfig,
+)
+from stoke_tpu.telemetry import read_step_events
+from stoke_tpu.telemetry.health import (
+    SENTINEL_FIELDS,
+    SENTINEL_INDEX,
+    GradNormSpikeDetector,
+    HangWatchdog,
+    LossSpikeDetector,
+    _RunningStats,
+    unpack_sentinels,
+)
+from stoke_tpu.telemetry.recorder import FlightRecorder
+
+pytestmark = pytest.mark.health
+
+IN, OUT = 8, 4
+
+
+def _make_stoke(tmp_path, *, health=True, distributed=None, grad_accum=1,
+                tag="run", health_over=None, telemetry_over=None):
+    """Linear-regression overfit scenario; optional 8-device dp mesh."""
+    configs = [TelemetryConfig(
+        output_dir=str(tmp_path / tag / "telemetry"),
+        log_every_n_steps=1,
+        grad_norm=True,
+        sample_device_time=False,
+        prometheus=False,
+        **(telemetry_over or {}),
+    )]
+    if health:
+        configs.append(HealthConfig(
+            dump_signals=False, **(health_over or {})
+        ))
+    return Stoke(
+        model=lambda p, x: x @ p["w"],
+        optimizer=StokeOptimizer(
+            optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.05}
+        ),
+        loss=lambda o, y: ((o - y) ** 2).mean(),
+        params={"w": np.ones((IN, OUT), np.float32) * 0.1},
+        batch_size_per_device=4,
+        grad_accum=grad_accum,
+        distributed=distributed,
+        configs=configs,
+        verbose=False,
+    )
+
+
+def _batches(n, rng, nan_at=None, batch=32):
+    """Deterministic overfit batches; ``nan_at`` poisons that step's batch
+    (0-indexed) with a NaN — the injected fault the detectors must catch."""
+    W = rng.normal(size=(IN, OUT)).astype(np.float32)
+    out = []
+    for i in range(n):
+        x = rng.normal(size=(batch, IN)).astype(np.float32)
+        if nan_at is not None and i == nan_at:
+            x = x.copy()
+            x[0, 0] = np.nan
+        out.append((x, (x @ W).astype(np.float32)))
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# detector math
+# --------------------------------------------------------------------------- #
+
+
+def test_running_stats_ema_math():
+    s = _RunningStats(alpha=0.5)
+    assert s.zscore(1.0) is None  # no baseline yet
+    s.update(10.0)
+    assert s.mean == 10.0 and s.var == 0.0
+    # constant stream: mean stays, variance stays 0, zscore of the
+    # constant is 0
+    for _ in range(5):
+        s.update(10.0)
+    assert s.mean == pytest.approx(10.0)
+    assert s.var == pytest.approx(0.0)
+    assert s.zscore(10.0) == 0.0
+    # a deviation with zero variance is infinitely surprising
+    assert s.zscore(11.0) == float("inf")
+    # after noisy updates the variance is positive and the z-score scales
+    # linearly with the deviation
+    for v in (9.0, 11.0, 9.0, 11.0):
+        s.update(v)
+    assert s.var > 0
+    z1 = s.zscore(s.mean + (s.var ** 0.5))
+    assert z1 == pytest.approx(1.0)
+    z3 = s.zscore(s.mean + 3 * (s.var ** 0.5))
+    assert z3 == pytest.approx(3.0)
+
+
+def test_loss_spike_detector_zscore_fires_and_baseline_clamps():
+    det = LossSpikeDetector("record", zscore=3.0, warmup=4, alpha=0.2)
+    # steady regime: a deterministic +/-1% oscillation around 1.0 keeps
+    # the running variance positive and never crosses 3 sigma
+    for step in range(20):
+        obs = {"step_loss": 1.0 + (0.01 if step % 2 else -0.01)}
+        assert det.check(step, obs, None) is None
+    a = det.check(99, {"step_loss": 50.0}, None)
+    assert a is not None
+    assert a.detector == "loss_spike" and a.step == 99 and a.value == 50.0
+    assert "sigma" in a.message
+    # the spike must not normalize the baseline: a repeat spike re-fires
+    assert det.check(100, {"step_loss": 50.0}, None) is not None
+
+
+def test_spike_detector_warmup_and_nonfinite_guard():
+    det = GradNormSpikeDetector("record", zscore=1.0, warmup=50, alpha=0.1)
+    for step in range(10):
+        assert det.check(step, {"grad_norm": 1.0}, None) is None
+    # under warmup even a huge value stays silent
+    assert det.check(10, {"grad_norm": 1e9}, None) is None
+    # non-finite values are the NonFiniteDetector's job and must not
+    # poison the EMA
+    assert det.check(11, {"grad_norm": float("nan")}, None) is None
+    assert np.isfinite(det.stats.mean)
+
+
+# --------------------------------------------------------------------------- #
+# flight-recorder ring
+# --------------------------------------------------------------------------- #
+
+
+def test_ring_buffer_bounds(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "b"), ring_size=5)
+    for i in range(12):
+        rec.record("note", {"i": i})
+    assert len(rec) == 5
+    ring = rec.ring
+    assert [e["i"] for e in ring] == [7, 8, 9, 10, 11]
+
+
+def test_bundle_dump_contents(tmp_path):
+    rec = FlightRecorder(
+        str(tmp_path / "b"),
+        ring_size=8,
+        status_dict={"device": "cpu"},
+        mesh_info={"axes": ["data"]},
+        snapshot_fn=lambda: {"m": {"kind": "counter", "value": 1.0}},
+    )
+    rec.record("note", {"msg": "hello"})
+    path = rec.dump("unit-test", extra={"k": "v"})
+    files = set(os.listdir(path))
+    assert {
+        "manifest.json", "ring.jsonl", "config.json", "mesh.json",
+        "environment.json", "registry.json", "stacks.txt",
+    } <= files
+    manifest = json.load(open(os.path.join(path, "manifest.json")))
+    assert manifest["reason"] == "unit-test"
+    assert manifest["extra"] == {"k": "v"}
+    stacks = open(os.path.join(path, "stacks.txt")).read()
+    assert "test_bundle_dump_contents" in stacks  # all-thread stacks: ours
+    # a second dump with the same reason gets a distinct directory
+    path2 = rec.dump("unit-test")
+    assert path2 != path and os.path.isdir(path2)
+    assert rec.dumps == [path, path2]
+
+
+def test_bundle_path_reported_to_supervisor_handshake(tmp_path, monkeypatch):
+    handshake = tmp_path / "bundles.txt"
+    monkeypatch.setenv("STOKE_HEALTH_BUNDLE_FILE", str(handshake))
+    rec = FlightRecorder(str(tmp_path / "b"), ring_size=2)
+    path = rec.dump("handshake")
+    assert handshake.read_text().strip() == path
+
+
+# --------------------------------------------------------------------------- #
+# sentinels on the 8-device mesh: values + zero extra dispatches
+# --------------------------------------------------------------------------- #
+
+
+def test_sentinels_in_jsonl_with_zero_extra_dispatches(tmp_path, devices):
+    """Acceptance criterion: with health on, per-step sentinels appear in
+    the JSONL step events and the engine dispatch count is UNCHANGED vs
+    health-off (the vector rides the existing compiled programs)."""
+    rng = np.random.default_rng(7)
+    batches = _batches(6, rng)
+
+    def run(tag, health):
+        s = _make_stoke(
+            tmp_path, health=health, distributed="dp", tag=tag
+        )
+        for x, y in batches[:3]:
+            s.train_step(x, (y,))      # fused path
+        for x, y in batches[3:]:
+            out = s.model(x)           # 4-call path
+            loss = s.loss(out, y)
+            s.backward(loss)
+            s.step()
+        s.close_telemetry()
+        return s
+
+    s_off = run("off", health=False)
+    s_on = run("on", health=True)
+    assert s_on.dispatch_count == s_off.dispatch_count
+    assert s_on.optimizer_steps == s_off.optimizer_steps == 6
+
+    recs = read_step_events(
+        os.path.join(str(tmp_path / "on" / "telemetry"), "steps.jsonl")
+    )
+    assert len(recs) == 6
+    for rec in recs:
+        assert rec["grad_norm"] is not None and rec["grad_norm"] > 0
+        assert rec["param_norm"] is not None and rec["param_norm"] > 0
+        assert rec["update_ratio"] is not None and rec["update_ratio"] > 0
+        assert rec["nonfinite_leaves"] == 0.0
+        assert rec["health_anomalies"] == 0.0
+    # both the fused and the 4-call records carry sentinel values — the
+    # old host-side sampling could never observe the fused path's buffer
+    assert recs[0]["grad_norm"] > 0 and recs[-1]["grad_norm"] > 0
+
+
+def test_sentinel_grad_norm_matches_host_sampling(tmp_path, devices):
+    """Satellite: TelemetryConfig.grad_norm delegates to the sentinel
+    vector (no second reduction); the values must agree with the retired
+    host-side sampling path on identical steps."""
+    rng_a = np.random.default_rng(11)
+    rng_b = np.random.default_rng(11)
+    s_off = _make_stoke(tmp_path, health=False, tag="host")
+    s_on = _make_stoke(tmp_path, health=True, tag="sentinel")
+    for s, rng in ((s_off, rng_a), (s_on, rng_b)):
+        for x, y in _batches(3, rng):
+            out = s.model(x)
+            loss = s.loss(out, y)
+            s.backward(loss)
+            s.step()
+        s.close_telemetry()
+    recs_off = read_step_events(
+        os.path.join(str(tmp_path / "host" / "telemetry"), "steps.jsonl")
+    )
+    recs_on = read_step_events(
+        os.path.join(str(tmp_path / "sentinel" / "telemetry"), "steps.jsonl")
+    )
+    for a, b in zip(recs_off, recs_on):
+        assert b["grad_norm"] == pytest.approx(a["grad_norm"], rel=1e-4)
+
+
+def test_sentinel_fields_unpack_roundtrip():
+    vec = np.arange(len(SENTINEL_FIELDS), dtype=np.float32)
+    d = unpack_sentinels(vec)
+    assert list(d) == list(SENTINEL_FIELDS)
+    assert d["step_loss"] == 0.0
+    assert d[SENTINEL_FIELDS[-1]] == float(len(SENTINEL_FIELDS) - 1)
+    assert SENTINEL_INDEX["grad_norm"] == 1
+
+
+# --------------------------------------------------------------------------- #
+# NaN injection: detection at step k + post-mortem bundle
+# --------------------------------------------------------------------------- #
+
+
+def test_nan_batch_detected_at_injection_step_with_bundle(tmp_path, devices):
+    """Acceptance criterion: a NaN injected at step k fires the nonfinite
+    detector AT step k and dumps a bundle whose ring contains the k-th
+    step's sentinel entry."""
+    s = _make_stoke(tmp_path, health=True, distributed="dp", tag="nan")
+    rng = np.random.default_rng(3)
+    k = 4  # poisoned optimizer step (1-indexed: the 4th train_step call)
+    for i, (x, y) in enumerate(_batches(5, rng, nan_at=k - 1)):
+        s.train_step(x, (y,))
+    h = s.health
+    fired = [a for a in h.anomalies if a.detector == "nonfinite_grads"]
+    assert fired, "nonfinite detector never fired"
+    assert fired[0].step == k
+    # default nonfinite action is "dump": exactly the poisoned steps wrote
+    # bundles (capped at max_dumps)
+    assert h.recorder.dumps, "no post-mortem bundle written"
+    bundle = h.recorder.dumps[0]
+    ring = [
+        json.loads(ln)
+        for ln in open(os.path.join(bundle, "ring.jsonl"))
+        if ln.strip()
+    ]
+    sentinel_steps = [
+        e["step"] for e in ring if e["kind"] == "sentinels"
+    ]
+    assert k in sentinel_steps  # the k-th event is in the ring
+    nan_entry = next(
+        e for e in ring
+        if e["kind"] == "sentinels" and e["step"] == k
+    )
+    assert nan_entry["values"]["nonfinite_leaves"] > 0
+    anomalies = [e for e in ring if e["kind"] == "anomaly"]
+    assert any(e["detector"] == "nonfinite_grads" for e in anomalies)
+    # counters surfaced through the registry (→ Prometheus/JSONL for free)
+    reg = s.telemetry.registry
+    assert reg.counter("health/anomalies_total").value >= 1
+    assert reg.counter("health/anomaly_nonfinite_grads_total").value >= 1
+    s.close_telemetry()
+
+
+def test_nan_detected_inside_train_steps_segment(tmp_path, devices):
+    """Multi-step scan path: sentinel rows come back stacked [n, S] and
+    the detector attributes the firing to the right step inside the
+    segment."""
+    s = _make_stoke(tmp_path, health=True, distributed="dp", tag="multi")
+    rng = np.random.default_rng(5)
+    batches = _batches(4, rng, nan_at=2)  # 3rd window of the segment
+    xs = np.stack([x for x, _ in batches])
+    ys = np.stack([y for _, y in batches])
+    s.train_steps(xs, (ys,))
+    fired = [
+        a for a in s.health.anomalies if a.detector == "nonfinite_grads"
+    ]
+    assert fired and fired[0].step == 3
+    s.close_telemetry()
+
+
+def test_health_halt_error_propagates(tmp_path, devices):
+    """halt action: HealthHaltError raises out of the facade call, carries
+    the anomaly + bundle path, and the bundle exists on disk."""
+    s = _make_stoke(
+        tmp_path, health=True, tag="halt",
+        health_over={"nonfinite_action": "halt"},
+    )
+    rng = np.random.default_rng(9)
+    batches = _batches(3, rng, nan_at=1)
+    s.train_step(batches[0][0], (batches[0][1],))
+    with pytest.raises(HealthHaltError) as ei:
+        s.train_step(batches[1][0], (batches[1][1],))
+    err = ei.value
+    assert err.anomalies and err.anomalies[0].detector == "nonfinite_grads"
+    assert err.bundle and os.path.isdir(err.bundle)
+    assert "health halt" in str(err)
+    s.close_telemetry()
+
+
+# --------------------------------------------------------------------------- #
+# watchdog
+# --------------------------------------------------------------------------- #
+
+
+def test_watchdog_unit_fires_once_per_arm():
+    trips = []
+    wd = HangWatchdog(0.15, lambda: trips.append(time.monotonic()))
+    try:
+        wd.arm()
+        deadline = time.monotonic() + 3.0
+        while not trips and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(trips) == 1
+        time.sleep(0.3)  # disarmed after firing: no repeat
+        assert len(trips) == 1
+        # a completed (disarmed) dispatch never fires
+        wd.arm()
+        wd.disarm()
+        time.sleep(0.3)
+        assert len(trips) == 1
+    finally:
+        wd.stop()
+
+
+def test_watchdog_trips_on_stalled_step_and_dumps_stacks(
+    tmp_path, devices, monkeypatch
+):
+    """Acceptance criterion: a stalled step trips the watchdog, which
+    writes a bundle with all-thread stacks (watchdog_kill off so the test
+    process survives)."""
+    s = _make_stoke(
+        tmp_path, health=True, tag="wd",
+        health_over={
+            "watchdog": True,
+            "watchdog_timeout_s": 0.2,
+            # no warm-up allowance: the stall IS the first dispatch here
+            "watchdog_compile_grace_s": 0.0,
+        },
+    )
+    engine = s._engine
+    real_fused = engine.fused_step
+
+    def stalled(*args, **kwargs):
+        time.sleep(0.8)  # the "wedged collective": dispatch never returns
+        return real_fused(*args, **kwargs)
+
+    monkeypatch.setattr(engine, "fused_step", stalled)
+    rng = np.random.default_rng(1)
+    (x, y), = _batches(1, rng)
+    s.train_step(x, (y,))
+    h = s.health
+    assert h.watchdog.trips >= 1
+    assert (
+        s.telemetry.registry.counter("health/watchdog_trips_total").value
+        >= 1
+    )
+    wd_bundles = [p for p in h.recorder.dumps if "watchdog" in p]
+    assert wd_bundles
+    stacks = open(os.path.join(wd_bundles[0], "stacks.txt")).read()
+    # the stalled training thread is visible in the all-thread dump
+    assert "stalled" in stacks
+    s.close_telemetry()
+
+
+def test_watchdog_no_false_trip_on_compile_or_segments(tmp_path, devices):
+    """A tight per-step timeout must not kill healthy runs: warm-up
+    compilation rides the compile grace, and a train_steps(n) segment —
+    one dispatch legitimately covering n steps — re-arms with n x the
+    timeout.  (Both would false-trip a fixed per-dispatch deadline.)"""
+    s = _make_stoke(
+        tmp_path, health=True, distributed="dp", tag="wd-ok",
+        health_over={
+            "watchdog": True,
+            # far below the first-dispatch compile time on this machine,
+            # and below a multi-step segment's run time
+            "watchdog_timeout_s": 0.75,
+            "watchdog_compile_grace_s": 120.0,
+        },
+    )
+    rng = np.random.default_rng(6)
+    batches = _batches(8, rng)
+    xs = np.stack([x for x, _ in batches])
+    ys = np.stack([y for _, y in batches])
+    s.train_steps(xs, (ys,))  # first dispatch: compile >> timeout
+    assert s.health.watchdog.trips == 0
+    s.train_steps(xs, (ys,))  # warm 8-step segment under the scaled deadline
+    assert s.health.watchdog.trips == 0
+    assert s.optimizer_steps == 16
+    s.close_telemetry()
+
+
+def test_watchdog_bundle_counted_in_registry(tmp_path, devices, monkeypatch):
+    """Every bundle — including a watchdog trip's — counts into
+    health/bundles_total (the Prometheus 'post-mortem bundles written'
+    series must not under-report)."""
+    s = _make_stoke(
+        tmp_path, health=True, tag="wd-count",
+        health_over={
+            "watchdog": True,
+            "watchdog_timeout_s": 0.2,
+            "watchdog_compile_grace_s": 0.0,
+        },
+    )
+    real_fused = s._engine.fused_step
+
+    def stalled(*args, **kwargs):
+        time.sleep(0.8)
+        return real_fused(*args, **kwargs)
+
+    monkeypatch.setattr(s._engine, "fused_step", stalled)
+    rng = np.random.default_rng(8)
+    (x, y), = _batches(1, rng)
+    s.train_step(x, (y,))
+    reg = s.telemetry.registry
+    assert reg.counter("health/watchdog_trips_total").value >= 1
+    assert reg.counter("health/bundles_total").value >= 1
+    s.close_telemetry()
+
+
+def test_exception_in_step_path_dumps_bundle(tmp_path, devices, monkeypatch):
+    s = _make_stoke(tmp_path, health=True, tag="exc")
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("synthetic step failure")
+
+    monkeypatch.setattr(s._engine, "fused_step", boom)
+    rng = np.random.default_rng(2)
+    (x, y), = _batches(1, rng)
+    with pytest.raises(RuntimeError, match="synthetic step failure"):
+        s.train_step(x, (y,))
+    exc_bundles = [p for p in s.health.recorder.dumps if "exception" in p]
+    assert exc_bundles
+    manifest = json.load(
+        open(os.path.join(exc_bundles[0], "manifest.json"))
+    )
+    assert "synthetic step failure" in manifest["extra"]["error"]
+    s.close_telemetry()
+
+
+def test_exception_dump_once_per_exception_and_capped(
+    tmp_path, devices, monkeypatch
+):
+    """Nested guarded calls (chunked train_steps recursion) must write ONE
+    bundle per exception, and repeated failing calls stop dumping at the
+    max_dumps budget."""
+    s = _make_stoke(
+        tmp_path, health=True, tag="exc-cap",
+        health_over={"max_dumps": 2},
+    )
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("chunk failure")
+
+    monkeypatch.setattr(s._engine, "multi_step", boom)
+    rng = np.random.default_rng(12)
+    batches = _batches(4, rng)
+    xs = np.stack([x for x, _ in batches])
+    ys = np.stack([y for _, y in batches])
+    # chunked: the outer train_steps recurses into guarded inner calls
+    with pytest.raises(RuntimeError, match="chunk failure"):
+        s.train_steps(xs, (ys,), segment_size=2)
+    assert len(s.health.recorder.dumps) == 1  # not one per nesting level
+    # retry loop: the exception-dump budget (max_dumps=2) caps the corpses
+    for _ in range(4):
+        with pytest.raises(RuntimeError):
+            s.train_steps(xs, (ys,), segment_size=2)
+    assert len(s.health.recorder.dumps) == 2
+    s.close_telemetry()
+
+
+def test_anomaly_totals_survive_bounded_object_window(tmp_path, devices):
+    """anomaly_count / per-detector counts are cumulative counters, not
+    len() of the bounded retained-object deque."""
+    from collections import deque
+
+    s = _make_stoke(
+        tmp_path, health=True, tag="bounded",
+        health_over={"nonfinite_action": "record"},
+    )
+    h = s.health
+    h.anomalies = deque(maxlen=2)  # shrink the retention window
+    row = np.zeros(len(SENTINEL_FIELDS), np.float32)
+    row[SENTINEL_INDEX["nonfinite_leaves"]] = 1.0
+    for step in range(1, 6):
+        h.observe(step, row)
+    assert len(h.anomalies) == 2  # bounded objects
+    assert h.anomaly_count == 5   # unbounded totals
+    assert h.anomaly_counts_by_detector() == {"nonfinite_grads": 5}
+    s.close_telemetry()
+
+
+def test_concurrent_dumps_get_distinct_directories(tmp_path):
+    """Same-second dumps from concurrent crash paths must not share (and
+    silently overwrite) one bundle directory."""
+    import threading
+
+    rec = FlightRecorder(str(tmp_path / "b"), ring_size=4)
+    paths = []
+    lock = threading.Lock()
+
+    def one():
+        p = rec.dump("race")
+        with lock:
+            paths.append(p)
+
+    threads = [threading.Thread(target=one) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(set(paths)) == 4
+    for p in paths:
+        assert os.path.exists(os.path.join(p, "manifest.json"))
+
+
+# --------------------------------------------------------------------------- #
+# default-off identity
+# --------------------------------------------------------------------------- #
+
+
+def test_default_off_is_inert(tmp_path):
+    """No HealthConfig: no monitor, no sentinels, the engine compiles the
+    sentinel slot as an empty pytree, and training works untouched."""
+    s = _make_stoke(tmp_path, health=False, tag="inert")
+    assert s.health is None
+    assert not s._engine.sentinels_enabled
+    rng = np.random.default_rng(4)
+    (x, y), = _batches(1, rng)
+    s.train_step(x, (y,))
+    assert s._last_sentinels is None
+    recs = read_step_events(
+        os.path.join(str(tmp_path / "inert" / "telemetry"), "steps.jsonl")
+    )
+    assert recs[0]["param_norm"] is None
+    assert recs[0]["health_anomalies"] is None
+    s.close_telemetry()
+
+
+# --------------------------------------------------------------------------- #
+# status rules
+# --------------------------------------------------------------------------- #
+
+
+def test_status_sentinels_require_telemetry():
+    with pytest.raises(StokeValidationError, match="TelemetryConfig"):
+        StokeStatus(batch_size_per_device=1, configs=[HealthConfig()])
+    # sentinels=False decouples from telemetry (detector-only mode)
+    st = StokeStatus(
+        batch_size_per_device=1, configs=[HealthConfig(sentinels=False)]
+    )
+    assert st.health_config is not None
+
+
+def test_status_halt_on_nonfinite_rejected_under_fp16(tmp_path):
+    tele = TelemetryConfig(output_dir=str(tmp_path / "t"))
+    with pytest.raises(StokeValidationError, match="fp16"):
+        StokeStatus(
+            batch_size_per_device=1,
+            precision="fp16",
+            configs=[tele, HealthConfig(nonfinite_action="halt")],
+        )
+    # the same config is legal at full precision
+    StokeStatus(
+        batch_size_per_device=1,
+        configs=[tele, HealthConfig(nonfinite_action="halt")],
+    )
+
+
+def test_status_watchdog_requires_positive_timeout(tmp_path):
+    tele = TelemetryConfig(output_dir=str(tmp_path / "t"))
+    with pytest.raises(StokeValidationError, match="watchdog_timeout_s"):
+        StokeStatus(
+            batch_size_per_device=1,
+            configs=[
+                tele,
+                HealthConfig(watchdog=True, watchdog_timeout_s=0.0),
+            ],
+        )
+
+
+def test_status_unknown_action_rejected(tmp_path):
+    tele = TelemetryConfig(output_dir=str(tmp_path / "t"))
+    with pytest.raises(StokeValidationError, match="loss_spike_action"):
+        StokeStatus(
+            batch_size_per_device=1,
+            configs=[tele, HealthConfig(loss_spike_action="explode")],
+        )
+
+
+def test_health_config_yaml_buildable(tmp_path):
+    """HealthConfig builds from the declarative YAML schema like every
+    other config class (configs: {HealthConfig: {...}})."""
+    from stoke_tpu.utils.yaml_config import stoke_kwargs_from_config
+
+    kwargs = stoke_kwargs_from_config({
+        "batch_size_per_device": 8,
+        "configs": {
+            "TelemetryConfig": {"output_dir": str(tmp_path / "t")},
+            "HealthConfig": {
+                "watchdog": True,
+                "watchdog_timeout_s": 120,
+                "nonfinite_action": "halt",
+            },
+        },
+    })
+    (hcfg,) = [
+        c for c in kwargs["configs"] if type(c).__name__ == "HealthConfig"
+    ]
+    assert hcfg.watchdog and hcfg.watchdog_timeout_s == 120
+    assert hcfg.nonfinite_action == "halt"

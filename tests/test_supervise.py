@@ -85,3 +85,44 @@ def test_supervise_relays_output_and_exit_code(tmp_path, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert json.loads(out.strip().splitlines()[-1]) == {"phase": "done"}
+
+
+def test_watchdog_exit_code_surfaced_with_bundle(tmp_path, capsys):
+    """A worker killed by the in-process stoke health watchdog (exit 113)
+    produces a structured supervisor line carrying the exit code and the
+    bundle paths the worker's flight recorder reported through the
+    STOKE_HEALTH_BUNDLE_FILE handshake — not a bare nonzero exit."""
+    worker = tmp_path / "wd.py"
+    worker.write_text(
+        "import json, os, sys\n"
+        "print(json.dumps({'phase': 'running'}), flush=True)\n"
+        "with open(os.environ['STOKE_HEALTH_BUNDLE_FILE'], 'a') as f:\n"
+        "    f.write('/tmp/fake-postmortem-dir\\n')\n"
+        "os._exit(113)\n"
+    )
+    rc = supervise(str(worker), [], watchdog_seconds=120, idle_seconds=60)
+    assert rc == _supervise.HEALTH_WATCHDOG_EXIT_CODE == 113
+    out = capsys.readouterr().out
+    line = json.loads(out.strip().splitlines()[-1])
+    assert line["watchdog_exit_code"] == 113
+    assert "health watchdog" in line["error"]
+    assert line["bundles"] == ["/tmp/fake-postmortem-dir"]
+
+
+def test_timeout_attaches_bundle_paths(tmp_path, capsys):
+    """The absolute-backstop kill attaches any bundles the worker wrote
+    before wedging, instead of a bare 'timed out'."""
+    worker = tmp_path / "hang.py"
+    worker.write_text(
+        "import json, os, time\n"
+        "print(json.dumps({'phase': 'running'}), flush=True)\n"
+        "with open(os.environ['STOKE_HEALTH_BUNDLE_FILE'], 'a') as f:\n"
+        "    f.write('/tmp/pre-wedge-bundle\\n')\n"
+        "time.sleep(300)\n"
+    )
+    rc = supervise(str(worker), [], watchdog_seconds=120, idle_seconds=5)
+    assert rc == 1
+    out = capsys.readouterr().out
+    line = json.loads(out.strip().splitlines()[-1])
+    assert "no output for 5s" in line["error"]
+    assert line["bundles"] == ["/tmp/pre-wedge-bundle"]

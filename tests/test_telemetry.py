@@ -148,6 +148,31 @@ def test_step_event_validation_rejects_bad_records():
         validate_step_event({**good, "surprise": 1})
 
 
+def test_sink_never_raises_on_invalid_record(tmp_path):
+    """Regression (ISSUE 3 satellite): a record that fails
+    validate_step_event used to raise ValueError THROUGH Sink.emit into
+    the training loop, violating the "sinks never raise" contract.  The
+    sink must warn once (naming the offending key), drop the record, and
+    stay alive for later valid records."""
+    import warnings as _warnings
+
+    path = str(tmp_path / "steps.jsonl")
+    sink = JsonlSink(path)
+    bad = {**_minimal_event(), "step": "five"}  # wrong type for 'step'
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        sink.emit(bad, {})   # must NOT raise
+        sink.emit(bad, {})   # second drop is silent
+    messages = [str(w.message) for w in caught]
+    assert len(messages) == 1
+    assert "step" in messages[0]  # the offending key is named
+    # the sink is still alive: a valid record flows after the drops
+    good = _minimal_event()
+    sink.emit(good, {})
+    sink.close()
+    assert read_step_events(path) == [good]
+
+
 def test_read_step_events_reports_bad_line(tmp_path):
     path = tmp_path / "steps.jsonl"
     path.write_text(json.dumps(_minimal_event()) + "\nnot json\n")
