@@ -172,7 +172,7 @@ REGRESSION_TOLERANCE = 0.05
 #: as cross-configuration (A/B arms, seg sweeps) rather than a like-for-like
 #: regression
 _REGRESSION_CONFIG_KEYS = (
-    "xla_flags", "steps_per_dispatch", "comm_dtype", "health"
+    "xla_flags", "steps_per_dispatch", "comm_dtype", "health", "attribution"
 )
 
 
@@ -447,6 +447,16 @@ def main():
                     "vector per step (one host sync), so a --health "
                     "capture is a distinct configuration for the "
                     "stale-substitution guard")
+    ap.add_argument("--attribution-peak-tflops", type=float, default=None,
+                    help="enable step-time attribution (ISSUE 4) on the "
+                    "measured run with this peak TFLOP/s as the MFU "
+                    "denominator (measure it with scripts/flops_probe.py's "
+                    "matmul-peak probe; v5e bf16 dense: 197).  The result "
+                    "and ledger descriptor gain mfu / achieved_tflops / "
+                    "goodput columns.  Attribution is host-side bookkeeping "
+                    "plus one cost-analysis per compiled program, but still "
+                    "a distinct configuration for the stale-substitution "
+                    "guard")
     ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
     if not args._worker:
@@ -454,6 +464,9 @@ def main():
             sys.argv[1:], args.preset,
             requested={
                 "health": True if args.health else None,
+                "attribution": (
+                    True if args.attribution_peak_tflops else None
+                ),
                 "api": args.api,
                 "batch": args.batch,
                 # explicit --seg N: a record at a different segment length
@@ -508,23 +521,33 @@ def main():
     run_configs = []
     if args.comm_dtype:
         run_configs.append(CommConfig(dtype=args.comm_dtype))
-    if args.health:
-        # health monitor arm (ISSUE 3): sentinels + detectors observe the
-        # measured run; the ledger descriptor records the anomaly counts.
-        # Telemetry is required by the status layer (sentinels surface
-        # through the step events) — JSONL only, quiet cadence, no
-        # device-time sampling, so the monitor itself is the only
-        # perturbation being measured.
+    if args.health or args.attribution_peak_tflops:
+        # health (ISSUE 3) / attribution (ISSUE 4) arms both ride the
+        # telemetry pipeline (status-validated requirement) — JSONL only,
+        # quiet cadence, no device-time sampling, so the monitor itself
+        # is the only perturbation being measured.
         import tempfile
 
-        from stoke_tpu import HealthConfig, TelemetryConfig
+        from stoke_tpu import TelemetryConfig
 
-        health_dir = tempfile.mkdtemp(prefix="stoke-bench-health-")
+        obs_dir = tempfile.mkdtemp(prefix="stoke-bench-obs-")
         run_configs.append(TelemetryConfig(
-            output_dir=health_dir, log_every_n_steps=10,
+            output_dir=obs_dir, log_every_n_steps=10,
             prometheus=False, tensorboard=False, sample_device_time=False,
         ))
+    if args.health:
+        from stoke_tpu import HealthConfig
+
         run_configs.append(HealthConfig(dump_signals=False))
+    if args.attribution_peak_tflops:
+        # attribution arm (ISSUE 4): CostCards + live MFU + goodput
+        # ledger observe the measured run; the ledger descriptor records
+        # the MFU/goodput columns.
+        from stoke_tpu import AttributionConfig
+
+        run_configs.append(AttributionConfig(
+            peak_tflops=args.attribution_peak_tflops,
+        ))
     stoke = Stoke(
         model=model,
         optimizer=StokeOptimizer(
@@ -625,6 +648,30 @@ def main():
         result["health_anomalies"] = h.anomaly_count
         result["health_by_detector"] = h.anomaly_counts_by_detector()
         result["health_bundles"] = len(h.recorder.dumps)
+    if args.attribution_peak_tflops:
+        # MFU/goodput columns (ISSUE 4): aggregate utilization of the
+        # measured run against the supplied peak, plus the goodput
+        # partition of its wall clock
+        g = stoke.goodput or {}
+        result["attribution"] = True
+        result["peak_tflops"] = args.attribution_peak_tflops
+        result["mfu"] = (
+            None if g.get("mfu") is None else round(g["mfu"], 6)
+        )
+        result["achieved_tflops"] = (
+            None if g.get("achieved_tflops") is None
+            else round(g["achieved_tflops"], 4)
+        )
+        result["goodput_fraction"] = (
+            None if g.get("goodput_fraction") is None
+            else round(g["goodput_fraction"], 4)
+        )
+        result["goodput_s"] = {
+            b: round(g.get(f"{b}_s", 0.0), 3)
+            for b in ("productive", "compile", "recompile", "loader",
+                      "checkpoint", "halt")
+        }
+    if args.health or args.attribution_peak_tflops:
         stoke.close_telemetry()
     if on_accel:
         regression = check_regression(
@@ -635,6 +682,9 @@ def main():
                 "steps_per_dispatch": per_call,
                 "comm_dtype": args.comm_dtype,
                 "health": True if args.health else None,
+                "attribution": (
+                    True if args.attribution_peak_tflops else None
+                ),
             },
         )
         if regression is not None:
@@ -672,6 +722,18 @@ def main():
                         "health_anomalies": result["health_anomalies"],
                     }
                     if args.health
+                    else {}
+                ),
+                **(
+                    {
+                        "attribution": True,
+                        "peak_tflops": args.attribution_peak_tflops,
+                        "mfu": result["mfu"],
+                        "achieved_tflops": result["achieved_tflops"],
+                        "goodput_fraction": result["goodput_fraction"],
+                        "goodput_s": result["goodput_s"],
+                    }
+                    if args.attribution_peak_tflops
                     else {}
                 ),
             },

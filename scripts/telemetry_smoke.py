@@ -24,6 +24,7 @@ def main() -> int:
     import optax
 
     from stoke_tpu import (
+        AttributionConfig,
         HealthConfig,
         Stoke,
         StokeOptimizer,
@@ -43,6 +44,10 @@ def main() -> int:
         grad_norm=True,
     )
     hcfg = HealthConfig(dump_signals=False)
+    # step-time attribution (ISSUE 4): one window through the CostCard /
+    # MFU / goodput path on CPU — peak is arbitrary here, only the
+    # plumbing is being proven
+    acfg = AttributionConfig(peak_tflops=1.0, peak_hbm_gbps=100.0)
     stoke = Stoke(
         model=lambda p, x: x @ p["w"],
         optimizer=StokeOptimizer(
@@ -51,7 +56,7 @@ def main() -> int:
         loss=lambda o, y: ((o - y) ** 2).mean(),
         params={"w": np.ones((8, 4), np.float32)},
         batch_size_per_device=16,
-        configs=[cfg, hcfg],
+        configs=[cfg, hcfg, acfg],
         verbose=False,
     )
     x = np.ones((16, 8), np.float32)
@@ -74,10 +79,23 @@ def main() -> int:
         and rec.get("nonfinite_leaves") == 0.0
         and rec.get("health_anomalies") == 0.0
     )
+    # ISSUE 4: the attribution window populated MFU + bound + a goodput
+    # partition, and the end-of-run goodput summary is coherent
+    goodput = stoke.goodput or {}
+    attribution_ok = (
+        rec.get("mfu") is not None
+        and rec.get("achieved_tflops") is not None
+        and rec.get("bound") in ("compute", "memory", "comm", "host")
+        and rec.get("goodput_productive_s") is not None
+        and goodput.get("windows", 0) >= 1
+        and goodput.get("goodput_fraction") is not None
+    )
     bundle_files = set(os.listdir(bundle)) if os.path.isdir(bundle) else set()
     bundle_ok = {
         "manifest.json", "ring.jsonl", "config.json", "mesh.json",
         "environment.json", "stacks.txt",
+        # ISSUE 4: utilization at time of death rides every bundle
+        "goodput.json", "cost_cards.json",
     } <= bundle_files
     ring_kinds = set()
     if bundle_ok:
@@ -94,8 +112,11 @@ def main() -> int:
         len(records) == 1
         and records[0]["step"] == 1
         and health_fields_ok
+        and attribution_ok
         and "stoke_jax_compiles_total" in prom
         and "stoke_health_anomalies_total" in prom
+        and "stoke_goodput_productive_s_total" in prom
+        and "stoke_attr_mfu" in prom
         and any(t.startswith("telemetry/") for t, _, _ in tb_events)
         and bundle_ok
         and {"sentinels", "step_event"} <= ring_kinds
@@ -109,6 +130,9 @@ def main() -> int:
         "bundle": bundle,
         "bundle_files": sorted(bundle_files),
         "ring_kinds": sorted(ring_kinds),
+        "mfu": rec.get("mfu"),
+        "bound": rec.get("bound"),
+        "goodput_fraction": goodput.get("goodput_fraction"),
     }))
     return 0 if ok else 1
 

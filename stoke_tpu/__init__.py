@@ -8,6 +8,7 @@ the reference ``__all__`` (stoke/__init__.py:17-43) adapted to TPU concepts.
 
 from stoke_tpu.configs import (
     ActivationCheckpointingConfig,
+    AttributionConfig,
     CheckpointConfig,
     CheckpointFormat,
     ClipGradConfig,
@@ -76,6 +77,7 @@ __all__ = [
     "LossReduction",
     "CheckpointFormat",
     # configs
+    "AttributionConfig",
     "PrecisionConfig",
     "ClipGradConfig",
     "ClipGradNormConfig",

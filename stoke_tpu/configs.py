@@ -774,6 +774,71 @@ class HealthConfig:
 
 
 @dataclass
+class AttributionConfig:
+    """Step-time attribution & goodput accounting (ISSUE 4 tentpole):
+    per-program cost cards, live MFU/roofline gauges, a goodput ledger,
+    and anomaly-triggered xprof capture.
+
+    Requires a :class:`TelemetryConfig` (the attribution values surface
+    through the JSONL step events and Prometheus exposition;
+    status-validated).  Default OFF — without this config the step paths
+    and compiled programs are untouched.  With it on, the engine runs
+    ONE XLA ``cost_analysis`` per compiled step program signature
+    (cached :class:`~stoke_tpu.telemetry.attribution.CostCard`) and the
+    telemetry record gains ``achieved_tflops`` / ``mfu`` /
+    ``hbm_bw_util`` / ``bound`` / ``goodput_*_s`` fields per window
+    (MLPerf-scale TPU practice: per-step utilization and goodput are the
+    primary scaling lens, arXiv:1909.09756).
+
+    Attributes:
+        peak_tflops: the chip's peak TFLOP/s for the active compute
+            dtype — MFU's denominator.  Must be > 0 (status-validated);
+            measure it with ``scripts/flops_probe.py``'s matmul-peak
+            probe or use the datasheet number (v5e bf16 dense: 197).
+        peak_hbm_gbps: HBM bandwidth peak (GB/s) for the
+            memory-roofline bound and the ``hbm_bw_util`` gauge; 0
+            disables the memory leg (compute-only roofline).
+        ici_gbps: per-device interconnect bandwidth (GB/s) used to
+            convert the gradient transport's analytic bytes-on-wire
+            (ISSUE 2) into an estimated comm time for the bound
+            classification; 0 disables the comm leg.
+        ema_alpha: EMA weight of the step-wall-time running stats the
+            capture z-score trigger uses.
+        auto_capture: arm the anomaly-triggered profiler capture.
+            Requires ``ProfilerConfig.trace_dir`` (status-validated):
+            captured xprof trace windows land under it as
+            ``auto-capture-<n>-step<k>-<reason>/``.
+        capture_mfu_below: trigger a capture when the window MFU drops
+            below this fraction (0 disables the MFU trigger).
+        capture_step_zscore: trigger when the window wall time is more
+            than this many running standard deviations above its EMA
+            (0 disables the z-score trigger).
+        capture_warmup_windows: windows before either trigger may fire
+            (the running stats need samples; warm-up compiles would
+            otherwise trip the z-score immediately).
+        capture_steps: optimizer steps one capture window covers before
+            the trace is stopped.
+        max_captures: per-run cap on captures (a permanently-degraded
+            run must not fill the disk with traces).
+        capture_action: health-detector action the capture surfaces as
+            when a ``HealthConfig`` is present (``record``/``warn``/
+            ``dump``; validated against HEALTH_ACTIONS).
+    """
+
+    peak_tflops: float = 0.0
+    peak_hbm_gbps: float = 0.0
+    ici_gbps: float = 0.0
+    ema_alpha: float = 0.1
+    auto_capture: bool = False
+    capture_mfu_below: float = 0.0
+    capture_step_zscore: float = 4.0
+    capture_warmup_windows: int = 5
+    capture_steps: int = 2
+    max_captures: int = 3
+    capture_action: str = "record"
+
+
+@dataclass
 class ProfilerConfig:
     """First-class profiling (SURVEY.md §5: native win over the reference's
     DeepSpeed flops-profiler passthrough, configs.py:252-279).
@@ -813,6 +878,7 @@ class StokeOptimizer(TypedDict):
 # All config classes recognized by the status layer, keyed by class name
 # (reference dedupe-by-class-name logic, status.py:321-343).
 ALL_CONFIG_CLASSES: Tuple[type, ...] = (
+    AttributionConfig,
     PrecisionConfig,
     ClipGradConfig,
     ClipGradNormConfig,

@@ -455,6 +455,13 @@ class StepEngine:
         # churn in the same process must not be charged to this run)
         self._shape_sigs: Dict[Any, set] = {}
         self._compile_tracker = None
+        # step-time attribution (ISSUE 4): CostCardCache assigned by the
+        # facade when an AttributionConfig is supplied.  Each dispatch
+        # site reports (program key + shape signature, jitted fn, live
+        # args) so the cache can run ONE cost_analysis per program
+        # signature and account analytic FLOPs/bytes per dispatch.  None
+        # -> zero bookkeeping, programs untouched.
+        self._attribution = None
         # shardings, resolved lazily once variables are known
         self._var_shardings = None
         self._grad_shardings = None
@@ -692,29 +699,53 @@ class StepEngine:
     #: alarm) and host memory stays bounded under pathological shape churn
     _MAX_SHAPE_SIGS = 1024
 
-    def _note_dispatch_shapes(self, key, *batch_trees) -> None:
+    @staticmethod
+    def _shape_sig(batch_trees) -> tuple:
+        """Input-shape signature of a dispatch's batch leaves — the key
+        both the structural recompile detector and the attribution
+        CostCard cache use to tell programs apart."""
+        return tuple(
+            (tuple(l.shape), str(getattr(l, "dtype", "")))
+            for l in jax.tree_util.tree_leaves(batch_trees)
+            if hasattr(l, "shape")
+        )
+
+    def _note_dispatch_shapes(self, key, *batch_trees) -> Optional[tuple]:
         """Telemetry hook: record the input-shape signature of a dispatch.
         First signature per program = warm-up compile; any LATER new
         signature means XLA silently recompiles the warm program (ragged
         batch / drifting pad length) — reported to THIS engine's
         ``CompileTracker`` (assigned by the facade; no bookkeeping at all
-        when telemetry is off)."""
+        when telemetry is off).  Returns the signature so the attribution
+        hook (:meth:`_note_cost`) reuses it instead of recomputing it on
+        the dispatch hot path; None when nobody needs one."""
         tracker = self._compile_tracker
+        if tracker is None and self._attribution is None:
+            return None
+        sig = self._shape_sig(batch_trees)
         if tracker is None:
-            return
+            return sig
         seen = self._shape_sigs.setdefault(key, set())
-        if len(seen) >= self._MAX_SHAPE_SIGS:
-            return
-        sig = tuple(
-            (tuple(l.shape), str(getattr(l, "dtype", "")))
-            for l in jax.tree_util.tree_leaves(batch_trees)
-            if hasattr(l, "shape")
-        )
-        if sig in seen:
-            return
+        if len(seen) >= self._MAX_SHAPE_SIGS or sig in seen:
+            return sig
         if seen:
             tracker.note_recompile()
         seen.add(sig)
+        return sig
+
+    def _note_cost(self, program: str, key, fn, args: tuple, steps: int,
+                   sig: Optional[tuple]) -> None:
+        """Attribution hook (ISSUE 4): account this dispatch's analytic
+        cost.  First call per (program key, shape signature) runs one XLA
+        cost analysis on ``fn`` at ``args``; every call adds the cached
+        card's FLOPs/bytes to the attribution counters.  ``sig`` is the
+        signature :meth:`_note_dispatch_shapes` already computed for this
+        dispatch.  No-op without an ``AttributionConfig`` (the facade
+        never assigns the cache)."""
+        attr = self._attribution
+        if attr is None:
+            return
+        attr.note_dispatch((key, sig or ()), program, fn, args, steps)
 
     # -------------------------- fused micro-step ----------------------- #
 
@@ -753,7 +784,16 @@ class StepEngine:
             self._accum_cache[struct_key] = self._build_accum(
                 loss_treedef, deferred_info, training
             )
-        self._note_dispatch_shapes(struct_key, margs, mkwargs, loss_args_flat)
+        sig = self._note_dispatch_shapes(
+            struct_key, margs, mkwargs, loss_args_flat
+        )
+        # micro-step: contributes FLOPs but completes no optimizer step
+        self._note_cost(
+            "accum", struct_key, self._accum_cache[struct_key],
+            (variables, grad_buf, scaler_state, rng, margs, mkwargs,
+             loss_args_flat),
+            0, sig,
+        )
         self.dispatch_count += 1
         with xprof_span("stoke/accum"):
             return self._accum_cache[struct_key](
@@ -987,8 +1027,14 @@ class StepEngine:
         )
         if key not in self._accum_cache:
             self._accum_cache[key] = self._build_window(loss_treedef, deferred_info)
-        self._note_dispatch_shapes(
+        sig = self._note_dispatch_shapes(
             key, margs_stacked, mkwargs_stacked, loss_args_flat_stacked
+        )
+        self._note_cost(
+            "window", key, self._accum_cache[key],
+            (variables, opt_state, grad_buf, scaler_state, comm_state, rng,
+             margs_stacked, mkwargs_stacked, loss_args_flat_stacked),
+            1, sig,
         )
         self.dispatch_count += 1
         with xprof_span("stoke/dispatch"):
@@ -1108,9 +1154,29 @@ class StepEngine:
         )
         if key not in self._accum_cache:
             self._accum_cache[key] = self._build_multi(loss_treedef, deferred_info)
-        self._note_dispatch_shapes(
+        sig = self._note_dispatch_shapes(
             key, margs_stacked, mkwargs_stacked, loss_args_flat_stacked
         )
+        if self._attribution is not None:
+            # one dispatch covers n complete optimizer steps
+            n_steps = next(
+                (
+                    l.shape[0]
+                    for l in jax.tree_util.tree_leaves(
+                        (margs_stacked, mkwargs_stacked,
+                         loss_args_flat_stacked)
+                    )
+                    if hasattr(l, "shape") and l.shape
+                ),
+                1,
+            )
+            self._note_cost(
+                "multi", key, self._accum_cache[key],
+                (variables, opt_state, grad_buf, scaler_state, comm_state,
+                 rng, margs_stacked, mkwargs_stacked,
+                 loss_args_flat_stacked),
+                int(n_steps), sig,
+            )
         self.dispatch_count += 1
         with xprof_span("stoke/dispatch"):
             return self._accum_cache[key](
@@ -1185,6 +1251,12 @@ class StepEngine:
         sentinel-vector slot before ``finite`` (None when off)."""
         if self._apply_fn is None:
             self._apply_fn = self._build_apply()
+        self._note_cost(
+            "apply", "apply", self._apply_fn,
+            (variables, opt_state, grad_buf, scaler_state, comm_state,
+             loss_val),
+            1, (),
+        )
         self.dispatch_count += 1
         with xprof_span("stoke/step"):
             return self._apply_fn(
@@ -1344,9 +1416,15 @@ class StepEngine:
             self._accum_cache[key] = self._build_fused(
                 loss_treedef, deferred_info, bool(do_apply)
             )
-        self._note_dispatch_shapes(key, margs, mkwargs, loss_args_flat)
+        sig = self._note_dispatch_shapes(key, margs, mkwargs, loss_args_flat)
         self.dispatch_count += 1
         if do_apply:
+            self._note_cost(
+                "fused", key, self._accum_cache[key],
+                (variables, opt_state, grad_buf, scaler_state, comm_state,
+                 rng, margs, mkwargs, loss_args_flat),
+                1, sig,
+            )
             with xprof_span("stoke/dispatch"):
                 return self._accum_cache[key](
                     variables, opt_state, grad_buf, scaler_state, comm_state,
@@ -1356,6 +1434,12 @@ class StepEngine:
         # transport state (quantization is once-per-step): both stay
         # wherever they live and the caller's references are echoed
         # untouched
+        self._note_cost(
+            "fused_nb", key, self._accum_cache[key],
+            (variables, grad_buf, scaler_state, rng, margs, mkwargs,
+             loss_args_flat),
+            0, sig,
+        )
         with xprof_span("stoke/dispatch"):
             (report, updated, new_vars, new_buf, new_scaler, new_rng,
              finite) = self._accum_cache[key](

@@ -435,6 +435,25 @@ class Stoke:
         self._engine._compile_tracker = self._telemetry.compile_tracker
         self._last_grad_norm: Optional[float] = None
 
+        # ----- step-time attribution & goodput (ISSUE 4: CostCards, live
+        #       MFU/roofline gauges, goodput ledger, anomaly-triggered
+        #       xprof capture; default OFF — without an AttributionConfig
+        #       the engine runs no cost analysis and the step programs
+        #       are untouched) -----
+        self._attribution = None
+        acfg = st.attribution_config
+        if acfg is not None:
+            from stoke_tpu.telemetry.attribution import AttributionMonitor
+
+            self._attribution = AttributionMonitor(
+                acfg,
+                self._telemetry.registry,
+                compile_tracker=self._telemetry.compile_tracker,
+                trace_dir=st.profiler_config.trace_dir,
+            )
+            self._telemetry.attribution = self._attribution
+            self._engine._attribution = self._attribution.cost_cards
+
         # ----- health monitor (ISSUE 3: sentinels + detectors + flight
         #       recorder + watchdog; default OFF — without a HealthConfig
         #       the step paths are untouched) -----
@@ -457,6 +476,19 @@ class Stoke:
                 mesh_info=self._mesh_info(),
                 snapshot_fn=self._telemetry.registry.snapshot,
                 install_signal_handlers=hcfg.dump_signals,
+                # ISSUE 4 satellite: a post-mortem shows utilization at
+                # time of death — the goodput summary and the last
+                # analyzed CostCards join every bundle
+                goodput_fn=(
+                    self._telemetry.goodput_summary
+                    if self._attribution is not None
+                    else None
+                ),
+                cost_cards_fn=(
+                    self._attribution.cost_cards.last_cards
+                    if self._attribution is not None
+                    else None
+                ),
             )
             self._health = HealthMonitor(
                 hcfg,
@@ -464,6 +496,19 @@ class Stoke:
                 recorder,
                 compile_tracker=self._telemetry.compile_tracker,
             )
+            if self._attribution is not None:
+                # the profiler auto-capture registers as a health
+                # detector (PR 3 registry): captures surface in the
+                # anomaly counters, ring, and post-mortem bundles
+                from stoke_tpu.telemetry.attribution import (
+                    AutoCaptureDetector,
+                )
+
+                self._health.detectors.append(
+                    AutoCaptureDetector(
+                        self._attribution, acfg.capture_action
+                    )
+                )
 
         # ----- wall-clock breakdown (reference wall_clock_breakdown,
         #       configs.py:540; host-side dispatch times — device work is
@@ -1137,6 +1182,21 @@ class Stoke:
         return self._health
 
     @property
+    def attribution(self):
+        """The run's step-time attribution monitor (None without an
+        ``AttributionConfig``) — cost cards, live MFU gauges, goodput
+        ledger, auto-capture state."""
+        return self._attribution
+
+    @property
+    def goodput(self) -> Optional[Dict[str, Any]]:
+        """End-of-run goodput accounting: cumulative bucket seconds
+        (productive/compile/recompile/loader/checkpoint/halt), goodput
+        fraction, aggregate achieved TFLOP/s + MFU, capture paths.  None
+        without an ``AttributionConfig``."""
+        return self._telemetry.goodput_summary()
+
+    @property
     def dispatch_count(self) -> int:
         """Compiled-program invocations issued by this run's engine (the
         health acceptance counter: sentinels must not add dispatches)."""
@@ -1149,6 +1209,10 @@ class Stoke:
         t = self._telemetry
         if not t.enabled or self._optimizer_steps == 0:
             return
+        if self._attribution is not None:
+            # per-boundary hook: closes an in-flight auto-capture trace
+            # window once it covered its configured step count
+            self._attribution.on_step(self._optimizer_steps)
         # samples/sec source of truth: one optimizer step consumes one
         # (global) effective batch — counted per boundary, emitted at the
         # cadence
@@ -1716,12 +1780,23 @@ class Stoke:
         return self._telemetry.wall_clock_breakdown()
 
     def print_wall_clock_breakdown(self) -> None:
+        # the goodput/* entries (attribution on) partition TOTAL wall
+        # clock, not host-dispatch time — percentaging each group against
+        # its own total keeps both reports truthful side by side
         breakdown = self.wall_clock_breakdown
-        total = sum(breakdown.values()) or 1.0
-        for phase, secs in sorted(breakdown.items(), key=lambda kv: -kv[1]):
-            self.print_on_devices(
-                f"wall_clock {phase}: {secs:.3f}s ({100 * secs / total:.1f}%)"
-            )
+        phases = {
+            k: v for k, v in breakdown.items() if not k.startswith("goodput/")
+        }
+        goodput = {
+            k: v for k, v in breakdown.items() if k.startswith("goodput/")
+        }
+        for group in (phases, goodput):
+            total = sum(group.values()) or 1.0
+            for phase, secs in sorted(group.items(), key=lambda kv: -kv[1]):
+                self.print_on_devices(
+                    f"wall_clock {phase}: {secs:.3f}s "
+                    f"({100 * secs / total:.1f}%)"
+                )
 
     def profile_trace(self, name: str = "stoke"):
         """Context manager capturing a ``jax.profiler`` trace (serves the
@@ -1754,13 +1829,32 @@ class Stoke:
     ) -> Optional[float]:
         """XLA cost-analysis FLOPs estimate of one fused optimizer step
         (replaces the reference's DeepSpeed flops profiler passthrough,
-        distributed.py:985-1004).  Returns None if the backend does not
-        report cost analysis."""
+        distributed.py:985-1004).  Thin wrapper over
+        :meth:`estimate_step_cost` (the shared CostCard path, ISSUE 4);
+        returns None if the backend does not report cost analysis —
+        warned ONCE per backend, with the negative result cached so
+        repeated calls neither warn nor re-lower."""
+        card = self.estimate_step_cost(model_args, loss_args)
+        if card is None or not card.flops:
+            return None
+        return float(card.flops)
+
+    def estimate_step_cost(self, model_args: Any, loss_args: Any = ()):
+        """Analytic :class:`~stoke_tpu.telemetry.attribution.CostCard` of
+        one fused optimizer step at these batch shapes: FLOPs, bytes
+        accessed, and (when an ``AttributionConfig`` supplies peaks) the
+        roofline-optimal step time.  The same cost-analysis funnel the
+        live attribution gauges and ``scripts/flops_probe.py`` use.
+        Returns None when the backend reports no cost analysis."""
         if not isinstance(model_args, tuple):
             model_args = (model_args,)
         if not isinstance(loss_args, tuple):
             loss_args = (loss_args,)
         from stoke_tpu.engine import DeferredOutput as _D
+        from stoke_tpu.telemetry.attribution import (
+            CostCard,
+            cost_analysis_of,
+        )
 
         margs = self._place_batch(model_args)
         sentinel = _D(None, -1)
@@ -1778,7 +1872,8 @@ class Stoke:
             opt_arg = self._disk_store.abstract()
         else:
             opt_arg = self._opt_state
-        lowered = fn.lower(
+        cost = cost_analysis_of(
+            fn,
             self._variables,
             opt_arg,
             self._grad_buf,
@@ -1789,19 +1884,16 @@ class Stoke:
             {},
             arrays,
         )
-        compiled = lowered.compile()  # real failures (bad shardings, OOM) raise
-        try:
-            cost = compiled.cost_analysis()
-        except Exception as e:  # backend reports no cost analysis
-            # None is the documented "backend doesn't report" value; surface
-            # the reason instead of swallowing it (VERDICT r1 weak #5)
-            self.warn(f"cost_analysis unavailable on this backend: {e!r}")
+        if cost is None:
             return None
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else None
-        if not cost or cost.get("flops") is None:
-            return None
-        return float(cost["flops"])
+        acfg = self._status_obj.attribution_config
+        return CostCard.from_cost(
+            cost,
+            "fused",
+            1,
+            peak_tflops=acfg.peak_tflops if acfg is not None else 0.0,
+            peak_hbm_gbps=acfg.peak_hbm_gbps if acfg is not None else 0.0,
+        )
 
     # ------------------------------------------------------------------ #
     # DataLoader factory (reference stoke.py:737-851)

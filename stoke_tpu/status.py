@@ -28,6 +28,7 @@ from stoke_tpu.configs import (
     COMM_STRATEGIES,
     HEALTH_ACTIONS,
     ActivationCheckpointingConfig,
+    AttributionConfig,
     CheckpointConfig,
     HealthConfig,
     ClipGradConfig,
@@ -491,6 +492,69 @@ class StokeStatus:
                 )
             return False
 
+        def _attribution_invalid(s):
+            """Attribution legality (ISSUE 4): the MFU/goodput gauges
+            surface through the telemetry step events (so a
+            TelemetryConfig is required), MFU needs a positive peak to
+            divide by, and the anomaly-triggered profiler capture writes
+            xprof traces into ``ProfilerConfig.trace_dir`` (so enabling
+            it without one would silently capture nothing)."""
+            cfg = self._configs.get("AttributionConfig")
+            if cfg is None:
+                return False
+            if "TelemetryConfig" not in self._configs:
+                return (
+                    "AttributionConfig requires a TelemetryConfig — the "
+                    "MFU/goodput attribution surfaces through the telemetry "
+                    "step events; add one or drop the config"
+                )
+            if cfg.peak_tflops <= 0:
+                return (
+                    f"AttributionConfig.peak_tflops must be > 0 (MFU's "
+                    f"denominator — measure it with scripts/flops_probe.py "
+                    f"or use the datasheet number), got {cfg.peak_tflops}"
+                )
+            if cfg.peak_hbm_gbps < 0 or cfg.ici_gbps < 0:
+                return (
+                    "AttributionConfig.peak_hbm_gbps/ici_gbps must be >= 0 "
+                    "(0 disables that roofline leg)"
+                )
+            if cfg.auto_capture:
+                pc = self._configs.get("ProfilerConfig")
+                if pc is None or pc.trace_dir is None:
+                    return (
+                        "AttributionConfig(auto_capture=True) requires "
+                        "ProfilerConfig.trace_dir — the captured xprof "
+                        "trace windows are written there; set it or "
+                        "disable auto_capture"
+                    )
+                if cfg.max_captures < 1 or cfg.capture_steps < 1:
+                    return (
+                        "AttributionConfig auto-capture needs "
+                        "max_captures >= 1 and capture_steps >= 1"
+                    )
+                if (
+                    cfg.capture_mfu_below <= 0
+                    and cfg.capture_step_zscore <= 0
+                ):
+                    return (
+                        "AttributionConfig(auto_capture=True) with both "
+                        "triggers disabled (capture_mfu_below <= 0 and "
+                        "capture_step_zscore <= 0) would never capture — "
+                        "enable at least one trigger"
+                    )
+            # 'halt' is deliberately excluded: a diagnostic trace capture
+            # must never be able to kill a multi-day run
+            valid_capture = [a for a in HEALTH_ACTIONS if a != "halt"]
+            if cfg.capture_action not in valid_capture:
+                return (
+                    f"AttributionConfig.capture_action "
+                    f"{cfg.capture_action!r} invalid; valid: "
+                    f"{valid_capture} (halt is not allowed — a profiler "
+                    f"capture is diagnostic, not fatal)"
+                )
+            return False
+
         def _offload_cpu_no_fallback(s):
             for name in ("OffloadOptimizerConfig", "OffloadParamsConfig"):
                 cfg = self._configs.get(name)
@@ -619,6 +683,10 @@ class StokeStatus:
             (
                 _health_invalid,
                 "HealthConfig is invalid for this combination",
+            ),
+            (
+                _attribution_invalid,
+                "AttributionConfig is invalid for this combination",
             ),
             (
                 _offload_cpu_no_fallback,
@@ -837,6 +905,13 @@ class StokeStatus:
         """None unless explicitly supplied (the health monitor is opt-in;
         without it the step paths are bit-identical to pre-ISSUE-3)."""
         return self._configs.get("HealthConfig")
+
+    @property
+    def attribution_config(self) -> Optional[AttributionConfig]:
+        """None unless explicitly supplied (step-time attribution is
+        opt-in; without it the step paths run no cost analysis and the
+        compiled programs are bit-identical to pre-ISSUE-4)."""
+        return self._configs.get("AttributionConfig")
 
     @property
     def telemetry_config(self) -> Optional[TelemetryConfig]:

@@ -53,6 +53,18 @@ from stoke_tpu.telemetry.health import (
     compute_sentinels,
     unpack_sentinels,
 )
+from stoke_tpu.telemetry.attribution import (
+    BOUND_CLASSES,
+    GOODPUT_BUCKETS,
+    AttributionMonitor,
+    AutoCaptureDetector,
+    CostCard,
+    CostCardCache,
+    classify_bound,
+    cost_analysis_of,
+    roofline_summary,
+    roofline_time_s,
+)
 from stoke_tpu.telemetry.recorder import FlightRecorder
 from stoke_tpu.telemetry.registry import (
     Counter,
@@ -98,6 +110,17 @@ __all__ = [
     "FlightRecorder",
     "compute_sentinels",
     "unpack_sentinels",
+    # step-time attribution & goodput (ISSUE 4)
+    "AttributionMonitor",
+    "AutoCaptureDetector",
+    "CostCard",
+    "CostCardCache",
+    "BOUND_CLASSES",
+    "GOODPUT_BUCKETS",
+    "classify_bound",
+    "cost_analysis_of",
+    "roofline_summary",
+    "roofline_time_s",
 ]
 
 
@@ -124,6 +147,10 @@ class Telemetry:
         self.registry = MetricsRegistry()
         self.sinks: List[Sink] = list(extra_sinks or [])
         self.compile_tracker: Optional[CompileTracker] = None
+        # step-time attribution monitor (ISSUE 4) — assigned by the
+        # facade when an AttributionConfig is supplied; None keeps
+        # record_step free of MFU/goodput computation entirely
+        self.attribution = None
         self._last_record: Dict[str, float] = {}
         # seeded now so the FIRST record's rates cover init->record wall
         # time (includes warm-up compiles — honest, if conservative)
@@ -202,12 +229,27 @@ class Telemetry:
 
     def wall_clock_breakdown(self) -> Dict[str, float]:
         """``{phase: cumulative host seconds}`` from the registry-backed
-        facade timers (the legacy ``Stoke.wall_clock_breakdown`` surface)."""
+        facade timers (the legacy ``Stoke.wall_clock_breakdown`` surface).
+        With attribution on (ISSUE 4), the cumulative goodput buckets are
+        merged in as ``goodput/<bucket>`` entries — one call answers both
+        "where did host dispatch go" and "where did wall clock go"."""
         out = {}
         for name in self.registry.names():
             if name.startswith("facade/") and name.endswith("_s"):
                 out[name[len("facade/"):-2]] = self.registry.get(name).value
+        if self.attribution is not None:
+            summary = self.attribution.goodput_summary()
+            for b in GOODPUT_BUCKETS:
+                out[f"goodput/{b}"] = summary[f"{b}_s"]
         return out
+
+    def goodput_summary(self) -> Optional[dict]:
+        """End-of-run goodput accounting (cumulative bucket seconds,
+        goodput fraction, aggregate achieved TFLOP/s + MFU, capture
+        paths); None without an ``AttributionConfig``."""
+        if self.attribution is None:
+            return None
+        return self.attribution.goodput_summary()
 
     # ------------------------------------------------------------------ #
     # step records
@@ -277,10 +319,15 @@ class Telemetry:
             update_hbm_gauges(self.registry)
 
         # host dispatch seconds this window: sum of facade phase deltas
+        # (checkpoint IO tracked separately — it feeds the goodput ledger)
         host_dispatch = 0.0
+        ckpt_io = 0.0
         for name in self.registry.names():
             if name.startswith("facade/") and name.endswith("_s"):
-                host_dispatch += self._delta(name)
+                d = self._delta(name)
+                host_dispatch += d
+                if name in ("facade/save_s", "facade/load_s"):
+                    ckpt_io += d
         loader_wait = self._delta("data/loader_wait_s")
         samples_delta = self._delta("data/samples_total")
         tokens_delta = self._delta("data/tokens_total")
@@ -315,6 +362,20 @@ class Telemetry:
             compiles = recompiles = 0
             compile_time = 0.0
 
+        # step-time attribution (ISSUE 4): per-window MFU/roofline gauges
+        # + goodput buckets, derived from the deltas computed above — one
+        # code path for all four facade step APIs
+        attr_fields: dict = {}
+        if self.attribution is not None:
+            attr_fields = self.attribution.window_stats(
+                step=step,
+                wall_s=wall_dt,
+                host_dispatch_s=host_dispatch,
+                loader_wait_s=loader_wait,
+                ckpt_io_s=ckpt_io,
+                comm_bytes_onwire=comm_wire,
+            )
+
         hbm = hbm_stats() if self.config.track_hbm else None
         record = build_step_event(
             ts=now,
@@ -347,6 +408,7 @@ class Telemetry:
             hbm_bytes_in_use=(hbm or {}).get("bytes_in_use"),
             hbm_peak_bytes=(hbm or {}).get("peak_bytes_in_use"),
             hbm_bytes_limit=(hbm or {}).get("bytes_limit"),
+            **attr_fields,
         )
         snapshot = self.registry.snapshot()
         for sink in self.sinks:
@@ -357,6 +419,11 @@ class Telemetry:
         if self._closed:
             return
         self._closed = True
+        if self.attribution is not None:
+            try:
+                self.attribution.close()  # stop an in-flight auto-capture
+            except Exception:
+                pass
         for sink in self.sinks:
             try:
                 sink.close()

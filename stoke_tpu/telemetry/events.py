@@ -83,6 +83,24 @@ STEP_EVENT_FIELDS: Dict[str, tuple] = {
     "update_ratio": (False, "nullable_number"),
     "nonfinite_leaves": (False, "nullable_number"),
     "health_anomalies": (False, "nullable_number"),
+    # step-time attribution (ISSUE 4; null without an AttributionConfig):
+    # per-window achieved TFLOP/s and MFU from the analytic CostCard
+    # FLOPs of every dispatched program, HBM-bandwidth utilization
+    # against the configured peak, and the compute/memory/comm/host
+    # bound classification
+    "achieved_tflops": (False, "nullable_number"),
+    "mfu": (False, "nullable_number"),
+    "hbm_bw_util": (False, "nullable_number"),
+    "bound": (False, "nullable_string"),
+    # goodput ledger (ISSUE 4): this window's wall clock partitioned
+    # into productive compute vs accounted losses; the buckets sum to
+    # the window wall time (ts delta to the previous record)
+    "goodput_productive_s": (False, "nullable_number"),
+    "goodput_compile_s": (False, "nullable_number"),
+    "goodput_recompile_s": (False, "nullable_number"),
+    "goodput_loader_s": (False, "nullable_number"),
+    "goodput_checkpoint_s": (False, "nullable_number"),
+    "goodput_halt_s": (False, "nullable_number"),
     "hbm_bytes_in_use": (False, "nullable_number"),
     "hbm_peak_bytes": (False, "nullable_number"),
     "hbm_bytes_limit": (False, "nullable_number"),
@@ -98,6 +116,8 @@ def _kind_ok(value: Any, kind: str) -> bool:
         return isinstance(value, numbers.Real) and not isinstance(value, bool)
     if kind == "nullable_number":
         return value is None or _kind_ok(value, "number")
+    if kind == "nullable_string":
+        return value is None or isinstance(value, str)
     if kind == "nullable_number_or_list":
         if value is None or _kind_ok(value, "number"):
             return True
@@ -189,6 +209,16 @@ def build_step_event(
     update_ratio: Optional[float] = None,
     nonfinite_leaves: Optional[float] = None,
     health_anomalies: Optional[float] = None,
+    achieved_tflops: Optional[float] = None,
+    mfu: Optional[float] = None,
+    hbm_bw_util: Optional[float] = None,
+    bound: Optional[str] = None,
+    goodput_productive_s: Optional[float] = None,
+    goodput_compile_s: Optional[float] = None,
+    goodput_recompile_s: Optional[float] = None,
+    goodput_loader_s: Optional[float] = None,
+    goodput_checkpoint_s: Optional[float] = None,
+    goodput_halt_s: Optional[float] = None,
     hbm_bytes_in_use: Optional[int] = None,
     hbm_peak_bytes: Optional[int] = None,
     hbm_bytes_limit: Optional[int] = None,
@@ -236,6 +266,21 @@ def build_step_event(
         "health_anomalies": (
             None if health_anomalies is None else float(health_anomalies)
         ),
+        # 9 digits: CPU-scale smoke runs produce sub-micro TFLOP/s values
+        # that 4-digit rounding would collapse to a lying 0.0
+        "achieved_tflops": _round(achieved_tflops, 9),
+        "mfu": _round(mfu, 9),
+        "hbm_bw_util": _round(hbm_bw_util, 9),
+        "bound": bound,
+        # goodput buckets are rounded uniformly so their sum stays within
+        # rounding distance of the window wall clock (the acceptance
+        # contract: buckets sum to wall time within 1%)
+        "goodput_productive_s": _round(goodput_productive_s),
+        "goodput_compile_s": _round(goodput_compile_s),
+        "goodput_recompile_s": _round(goodput_recompile_s),
+        "goodput_loader_s": _round(goodput_loader_s),
+        "goodput_checkpoint_s": _round(goodput_checkpoint_s),
+        "goodput_halt_s": _round(goodput_halt_s),
         "hbm_bytes_in_use": hbm_bytes_in_use,
         "hbm_peak_bytes": hbm_peak_bytes,
         "hbm_bytes_limit": hbm_bytes_limit,

@@ -604,6 +604,11 @@ class HealthMonitor:
         registry.counter(
             "health/watchdog_trips_total", help="hang-watchdog firings"
         )
+        registry.counter(
+            "health/halt_s",
+            help="wall seconds spent writing health dumps / halting "
+            "(the goodput ledger's halt bucket, ISSUE 4)",
+        )
 
     # ------------------------------ hooks ------------------------------ #
 
@@ -650,7 +655,15 @@ class HealthMonitor:
         counter: the handler must stay registry-free to be
         deadlock-safe.)"""
         self.registry.counter("health/bundles_total").inc()
-        return self.recorder.dump(reason, extra)
+        t0 = time.monotonic()
+        try:
+            return self.recorder.dump(reason, extra)
+        finally:
+            # wall clock lost to the dump: the goodput ledger's halt
+            # bucket (ISSUE 4) reads this counter's per-window delta
+            self.registry.counter("health/halt_s").inc(
+                time.monotonic() - t0
+            )
 
     def close(self) -> None:
         if self.watchdog is not None:

@@ -17,6 +17,9 @@ triage without re-running:
         environment.json    python/jax/numpy versions, JAX_*/XLA_* env,
                             argv, cwd
         registry.json       latest telemetry-registry snapshot (when wired)
+        goodput.json        goodput/utilization summary (when attribution
+                            is on — ISSUE 4)
+        cost_cards.json     last analyzed per-program CostCards (ditto)
         stacks.txt          faulthandler all-thread stacks at dump time
 
 Bundles are cheap (the ring is small) and atomic enough for crash paths:
@@ -83,6 +86,8 @@ class FlightRecorder:
         mesh_info: Optional[Dict[str, Any]] = None,
         snapshot_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         install_signal_handlers: bool = False,
+        goodput_fn: Optional[Callable[[], Optional[Dict[str, Any]]]] = None,
+        cost_cards_fn: Optional[Callable[[], Any]] = None,
     ):
         self.bundle_dir = bundle_dir
         self._ring: "deque[dict]" = deque(maxlen=int(ring_size))
@@ -94,6 +99,10 @@ class FlightRecorder:
         self._status_dict = status_dict
         self._mesh_info = mesh_info
         self._snapshot_fn = snapshot_fn
+        # ISSUE 4: utilization at time of death — the goodput summary and
+        # the last analyzed CostCards join every bundle when wired
+        self._goodput_fn = goodput_fn
+        self._cost_cards_fn = cost_cards_fn
         self.dumps: List[str] = []
         self._prev_handlers: Dict[int, Any] = {}
         if install_signal_handlers:
@@ -180,6 +189,20 @@ class FlightRecorder:
         if self._snapshot_fn is not None:
             try:
                 self._write_json(path, "registry.json", self._snapshot_fn())
+            except Exception:
+                pass
+        if self._goodput_fn is not None:
+            try:
+                goodput = self._goodput_fn()
+                if goodput is not None:
+                    self._write_json(path, "goodput.json", goodput)
+            except Exception:
+                pass
+        if self._cost_cards_fn is not None:
+            try:
+                cards = self._cost_cards_fn()
+                if cards:
+                    self._write_json(path, "cost_cards.json", cards)
             except Exception:
                 pass
         self._write_stacks(path)

@@ -1,0 +1,632 @@
+"""Step-time attribution & goodput tests (ISSUE 4): CostCard caching,
+bound classification, goodput accounting, status rules, default-OFF
+program identity, JSONL fields on the 8-device mesh, and the
+anomaly-triggered profiler auto-capture.
+
+All CPU-only and deterministic on the 8-device simulated mesh (conftest).
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from stoke_tpu import (
+    AttributionConfig,
+    HealthConfig,
+    ProfilerConfig,
+    Stoke,
+    StokeOptimizer,
+    StokeStatus,
+    StokeValidationError,
+    TelemetryConfig,
+)
+from stoke_tpu.telemetry import read_step_events
+from stoke_tpu.telemetry.attribution import (
+    GOODPUT_BUCKETS,
+    AutoCaptureDetector,
+    classify_bound,
+    cost_analysis_of,
+    roofline_summary,
+    roofline_time_s,
+)
+
+pytestmark = pytest.mark.attribution
+
+IN, OUT = 8, 4
+PEAK = 1e-3  # "peak TFLOP/s" scaled so toy CPU steps produce visible MFU
+
+
+def _make_stoke(tmp_path, *, attribution=True, distributed="dp",
+                grad_accum=1, tag="run", attr_over=None, configs_extra=()):
+    configs = [TelemetryConfig(
+        output_dir=str(tmp_path / tag / "telemetry"),
+        log_every_n_steps=1,
+        sample_device_time=False,
+        prometheus=False,
+    )]
+    if attribution:
+        configs.append(AttributionConfig(
+            peak_tflops=PEAK, peak_hbm_gbps=1.0, **(attr_over or {})
+        ))
+    configs.extend(configs_extra)
+    return Stoke(
+        model=lambda p, x: x @ p["w"],
+        optimizer=StokeOptimizer(
+            optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.05}
+        ),
+        loss=lambda o, y: ((o - y) ** 2).mean(),
+        params={"w": np.ones((IN, OUT), np.float32) * 0.1},
+        batch_size_per_device=4,
+        grad_accum=grad_accum,
+        distributed=distributed,
+        configs=configs,
+        verbose=False,
+    )
+
+
+def _batches(n, rng, batch=32):
+    W = rng.normal(size=(IN, OUT)).astype(np.float32)
+    out = []
+    for _ in range(n):
+        x = rng.normal(size=(batch, IN)).astype(np.float32)
+        out.append((x, (x @ W).astype(np.float32)))
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# pure math: roofline + bound classification
+# --------------------------------------------------------------------------- #
+
+
+def test_roofline_time_and_summary():
+    # compute-limited: 2 TFLOP at 1 TFLOP/s peak -> 2 s
+    assert roofline_time_s(2e12, None, 1.0) == pytest.approx(2.0)
+    # memory-limited: 1 GB at 100 GB/s dominates 1 GFLOP at 1 TFLOP/s
+    t = roofline_time_s(1e9, 1e9, 1.0, 100.0)
+    assert t == pytest.approx(max(1e9 / 1e12, 1e9 / 100e9))
+    assert roofline_time_s(1e9, None, 0.0) is None
+    rl = roofline_summary(1e12, 2.0, 1.0)
+    assert rl["achieved_tflops"] == pytest.approx(0.5)
+    assert rl["mfu"] == pytest.approx(0.5)
+    assert roofline_summary(None, 1.0, 1.0)["mfu"] is None
+    assert roofline_summary(1e12, 0.0, 1.0)["achieved_tflops"] is None
+
+
+def test_classify_bound_synthetic_timings():
+    # compute dominates and explains most of the wall clock
+    assert classify_bound(
+        wall_s=1.0, compute_optimal_s=0.8, memory_optimal_s=0.2,
+        comm_s=0.1, host_s=0.05,
+    ) == "compute"
+    # memory roofline dominates
+    assert classify_bound(
+        wall_s=1.0, compute_optimal_s=0.2, memory_optimal_s=0.9,
+        comm_s=0.1, host_s=0.0,
+    ) == "memory"
+    # comm estimate dominates
+    assert classify_bound(
+        wall_s=1.0, compute_optimal_s=0.1, memory_optimal_s=0.1,
+        comm_s=0.7, host_s=0.0,
+    ) == "comm"
+    # loader starvation covers half the window: host wins outright,
+    # whatever the device-side estimates say
+    assert classify_bound(
+        wall_s=1.0, compute_optimal_s=0.9, memory_optimal_s=0.9,
+        comm_s=0.9, host_s=0.6,
+    ) == "host"
+    # nothing explains the window -> host/overhead-bound by elimination
+    assert classify_bound(
+        wall_s=1.0, compute_optimal_s=0.05, memory_optimal_s=0.02,
+        comm_s=0.0, host_s=0.1,
+    ) == "host"
+    # degenerate window
+    assert classify_bound(
+        wall_s=0.0, compute_optimal_s=1.0, memory_optimal_s=None,
+        comm_s=None, host_s=0.0,
+    ) is None
+
+
+# --------------------------------------------------------------------------- #
+# CostCard caching: one cost_analysis per program signature
+# --------------------------------------------------------------------------- #
+
+
+def test_cost_card_cached_once_per_signature(tmp_path, devices):
+    s = _make_stoke(tmp_path)
+    rng = np.random.default_rng(0)
+    for x, y in _batches(4, rng):
+        s.train_step(x, (y,))          # one fused-boundary program
+    for x, y in _batches(3, rng):
+        out = s.model(x)               # 4-call path: accum + apply
+        loss = s.loss(out, y)
+        s.backward(loss)
+        s.step()
+    cache = s.attribution.cost_cards
+    # exactly one analysis per distinct (program, signature): fused,
+    # accum, apply — NOT one per dispatch
+    assert cache.cost_analysis_runs == 3
+    assert len(cache.cards) == 3
+    assert {c.program for c in cache.cards.values()} == {
+        "fused", "accum", "apply"
+    }
+    for card in cache.cards.values():
+        assert card.flops > 0
+        assert card.optimal_time_s is not None and card.optimal_time_s > 0
+    # a NEW batch shape is a new signature -> one more analysis
+    x, y = _batches(1, rng, batch=16)[0]
+    s.train_step(x, (y,))
+    assert cache.cost_analysis_runs == 4
+    # the per-dispatch FLOP counter accumulated across every dispatch
+    flops_total = s.telemetry.registry.get("attr/flops_total").value
+    assert flops_total > sum(c.flops for c in cache.cards.values())
+    s.close_telemetry()
+
+
+def test_cost_cards_cover_window_and_multi_paths(tmp_path, devices):
+    s = _make_stoke(tmp_path, grad_accum=2)
+    r = np.random.default_rng(1)
+    xs = r.normal(size=(2, 16, IN)).astype(np.float32)
+    ys = r.normal(size=(2, 16, OUT)).astype(np.float32)
+    s.train_step_window(xs, (ys,))
+    xs4 = r.normal(size=(4, 16, IN)).astype(np.float32)
+    ys4 = r.normal(size=(4, 16, OUT)).astype(np.float32)
+    s.train_steps(xs4, (ys4,))
+    cache = s.attribution.cost_cards
+    programs = {c.program: c for c in cache.cards.values()}
+    assert set(programs) == {"window", "multi"}
+    assert programs["window"].steps == 1
+    assert programs["multi"].steps == 2  # 4 stacked micros / grad_accum 2
+    # the multi program runs 2 complete steps per dispatch: its analytic
+    # FLOPs must exceed one window's
+    assert programs["multi"].flops > programs["window"].flops
+    s.close_telemetry()
+
+
+def test_cost_card_cache_bounded_under_shape_churn(monkeypatch):
+    """Beyond _MAX_CARDS, unseen signatures neither retrace nor grow the
+    cache — they reuse the program's last card (same bounding policy as
+    the engine's recompile detector)."""
+    from stoke_tpu.telemetry.attribution import CostCardCache
+    from stoke_tpu.telemetry.registry import MetricsRegistry
+
+    class _Fake:
+        def lower(self, *a):
+            return self
+
+        def cost_analysis(self):
+            return {"flops": 100.0, "bytes accessed": 10.0}
+
+    monkeypatch.setattr(CostCardCache, "_MAX_CARDS", 3)
+    cache = CostCardCache(MetricsRegistry(), peak_tflops=1.0)
+    for i in range(3):
+        cache.note_dispatch(("p", i), "fused", _Fake(), (), 1)
+    assert cache.cost_analysis_runs == 3 and len(cache.cards) == 3
+
+    class _Explodes:
+        def lower(self, *a):
+            raise AssertionError("must not retrace beyond the card cap")
+
+    card = cache.note_dispatch(("p", 99), "fused", _Explodes(), (), 1)
+    assert card is not None and card.flops == 100.0  # program fallback
+    assert cache.cost_analysis_runs == 3 and len(cache.cards) == 3
+    # FLOP accounting continued through the fallback
+    assert cache.registry.get("attr/flops_total").value == 400.0
+    # a program KIND first seen past the cap still gets its one analysis
+    # (its FLOPs must not be silently dropped forever)
+    card2 = cache.note_dispatch(("q", 0), "apply", _Fake(), (), 1)
+    assert card2 is not None and card2.flops == 100.0
+    assert cache.cost_analysis_runs == 4
+
+
+# --------------------------------------------------------------------------- #
+# JSONL fields + goodput partition on the 8-device mesh (acceptance)
+# --------------------------------------------------------------------------- #
+
+
+def test_jsonl_attribution_fields_and_goodput_sums(tmp_path, devices):
+    s = _make_stoke(tmp_path)
+    rng = np.random.default_rng(2)
+    for x, y in _batches(6, rng):
+        s.train_step(x, (y,))
+    s.close_telemetry()
+    recs = read_step_events(
+        os.path.join(str(tmp_path / "run" / "telemetry"), "steps.jsonl")
+    )
+    assert len(recs) == 6
+    for rec in recs:
+        assert rec["mfu"] is not None and rec["mfu"] > 0
+        assert rec["achieved_tflops"] is not None
+        assert rec["achieved_tflops"] > 0
+        assert rec["bound"] in ("compute", "memory", "comm", "host")
+        assert rec["hbm_bw_util"] is not None and rec["hbm_bw_util"] > 0
+        for b in GOODPUT_BUCKETS:
+            assert rec[f"goodput_{b}_s"] is not None
+            assert rec[f"goodput_{b}_s"] >= 0
+    # acceptance: goodput buckets partition the window wall clock (the ts
+    # delta between consecutive records) within 1%
+    for prev, cur in zip(recs, recs[1:]):
+        wall = cur["ts"] - prev["ts"]
+        total = sum(cur[f"goodput_{b}_s"] for b in GOODPUT_BUCKETS)
+        assert total == pytest.approx(wall, rel=0.01, abs=1e-4)
+    # end-of-run summary is coherent and wall_clock_breakdown aliases it
+    g = s.goodput
+    assert g["windows"] == 6
+    assert g["wall_s"] == pytest.approx(
+        sum(g[f"{b}_s"] for b in GOODPUT_BUCKETS), rel=0.01
+    )
+    assert 0.0 <= g["goodput_fraction"] <= 1.0
+    assert g["mfu"] is not None and g["mfu"] > 0
+    wcb = s.wall_clock_breakdown
+    for b in GOODPUT_BUCKETS:
+        assert wcb[f"goodput/{b}"] == pytest.approx(g[f"{b}_s"])
+
+
+def test_disabled_attribution_emits_null_fields(tmp_path, devices):
+    s = _make_stoke(tmp_path, attribution=False)
+    rng = np.random.default_rng(3)
+    for x, y in _batches(2, rng):
+        s.train_step(x, (y,))
+    s.close_telemetry()
+    recs = read_step_events(
+        os.path.join(str(tmp_path / "run" / "telemetry"), "steps.jsonl")
+    )
+    for rec in recs:
+        assert rec["mfu"] is None
+        assert rec["bound"] is None
+        assert rec["goodput_productive_s"] is None
+    assert s.goodput is None
+    assert "goodput/productive" not in s.wall_clock_breakdown
+
+
+# --------------------------------------------------------------------------- #
+# default-OFF identity (acceptance: bit-identical step programs)
+# --------------------------------------------------------------------------- #
+
+
+def test_attribution_off_is_bit_identical_and_on_adds_no_dispatches(
+    tmp_path, devices
+):
+    """Attribution is host-side bookkeeping only: the engine dispatch
+    count AND the lowered step-program HLO are identical with the config
+    absent vs present (same technique as the PR 3 sentinel acceptance)."""
+    rng_a = np.random.default_rng(5)
+    rng_b = np.random.default_rng(5)
+    s_off = _make_stoke(tmp_path, attribution=False, tag="off")
+    s_on = _make_stoke(tmp_path, attribution=True, tag="on")
+    batches_a = _batches(4, rng_a)
+    batches_b = _batches(4, rng_b)
+    for s, batches in ((s_off, batches_a), (s_on, batches_b)):
+        for x, y in batches[:2]:
+            s.train_step(x, (y,))
+        for x, y in batches[2:]:
+            out = s.model(x)
+            loss = s.loss(out, y)
+            s.backward(loss)
+            s.step()
+        s.close_telemetry()
+    assert s_on.dispatch_count == s_off.dispatch_count
+    assert s_on.optimizer_steps == s_off.optimizer_steps == 4
+    # trained parameters are bit-identical: same compiled math ran
+    np.testing.assert_array_equal(
+        np.asarray(s_on.params["w"]), np.asarray(s_off.params["w"])
+    )
+    # HLO-signature assertion: the fused step program lowers to the same
+    # text with and without attribution
+    x, y = batches_a[0]
+
+    def fused_hlo(s):
+        from stoke_tpu.engine import DeferredOutput, is_deferred
+
+        margs = s._place_batch((x,))
+        sentinel = DeferredOutput(None, -1)
+        flat, treedef = jax.tree_util.tree_flatten(
+            ((sentinel, y), {}), is_leaf=is_deferred
+        )
+        arrays = s._place_batch([l for l in flat if not is_deferred(l)])
+        deferred = tuple(
+            (i, l._path) for i, l in enumerate(flat) if is_deferred(l)
+        )
+        fn = s._engine._build_fused(treedef, deferred, True)
+        return fn.lower(
+            s._variables, s._opt_state, s._grad_buf, s._scaler_state,
+            s._comm_state, s._rng, margs, {}, arrays,
+        ).as_text()
+
+    assert fused_hlo(s_on) == fused_hlo(s_off)
+
+
+# --------------------------------------------------------------------------- #
+# status rules
+# --------------------------------------------------------------------------- #
+
+
+def _status(configs, **kw):
+    return StokeStatus(batch_size_per_device=4, configs=configs, **kw)
+
+
+def test_status_requires_telemetry(tmp_path):
+    with pytest.raises(StokeValidationError, match="requires a TelemetryConfig"):
+        _status([AttributionConfig(peak_tflops=100.0)])
+
+
+def test_status_requires_positive_peak(tmp_path):
+    tcfg = TelemetryConfig(output_dir=str(tmp_path / "t"), prometheus=False)
+    with pytest.raises(StokeValidationError, match="peak_tflops"):
+        _status([tcfg, AttributionConfig()])
+    with pytest.raises(StokeValidationError, match="peak_tflops"):
+        _status([tcfg, AttributionConfig(peak_tflops=-1.0)])
+    # valid combination passes
+    _status([tcfg, AttributionConfig(peak_tflops=197.0)])
+
+
+def test_status_auto_capture_requires_trace_dir(tmp_path):
+    tcfg = TelemetryConfig(output_dir=str(tmp_path / "t"), prometheus=False)
+    with pytest.raises(StokeValidationError, match="trace_dir"):
+        _status([tcfg, AttributionConfig(peak_tflops=1.0, auto_capture=True)])
+    # with a trace dir it passes
+    _status([
+        tcfg,
+        ProfilerConfig(trace_dir=str(tmp_path / "tr")),
+        AttributionConfig(peak_tflops=1.0, auto_capture=True),
+    ])
+    # ... but not with both triggers disabled
+    with pytest.raises(StokeValidationError, match="never capture"):
+        _status([
+            tcfg,
+            ProfilerConfig(trace_dir=str(tmp_path / "tr")),
+            AttributionConfig(
+                peak_tflops=1.0, auto_capture=True,
+                capture_mfu_below=0.0, capture_step_zscore=0.0,
+            ),
+        ])
+
+
+def test_status_capture_action_validated(tmp_path):
+    tcfg = TelemetryConfig(output_dir=str(tmp_path / "t"), prometheus=False)
+    with pytest.raises(StokeValidationError, match="capture_action"):
+        _status([
+            tcfg,
+            AttributionConfig(peak_tflops=1.0, capture_action="explode"),
+        ])
+    # 'halt' is a health action but NOT a capture action: a diagnostic
+    # trace capture must never kill a run
+    with pytest.raises(StokeValidationError, match="halt"):
+        _status([
+            tcfg,
+            AttributionConfig(peak_tflops=1.0, capture_action="halt"),
+        ])
+
+
+def test_attribution_config_yaml_buildable(tmp_path):
+    from stoke_tpu.utils import stoke_kwargs_from_config
+
+    kwargs = stoke_kwargs_from_config({
+        "batch_size_per_device": 4,
+        "configs": {
+            "TelemetryConfig": {
+                "output_dir": str(tmp_path / "t"), "prometheus": False,
+            },
+            "AttributionConfig": {
+                "peak_tflops": 197.0, "peak_hbm_gbps": 819.0,
+            },
+        },
+    })
+    by_type = {type(c).__name__: c for c in kwargs["configs"]}
+    assert by_type["AttributionConfig"].peak_tflops == 197.0
+    assert by_type["AttributionConfig"].peak_hbm_gbps == 819.0
+
+
+# --------------------------------------------------------------------------- #
+# estimate_step_flops: shared path + warn-once negative caching
+# --------------------------------------------------------------------------- #
+
+
+def test_estimate_step_flops_via_cost_card(tmp_path, devices):
+    s = _make_stoke(tmp_path)
+    x = np.ones((32, IN), np.float32)
+    y = np.zeros((32, OUT), np.float32)
+    card = s.estimate_step_cost(x, (y,))
+    assert card is not None and card.program == "fused"
+    assert card.flops > 0
+    assert card.bytes_accessed is not None and card.bytes_accessed > 0
+    assert card.optimal_time_s is not None and card.optimal_time_s > 0
+    flops = s.estimate_step_flops(x, (y,))
+    assert flops == pytest.approx(card.flops)
+    s.close_telemetry()
+
+
+def test_cost_analysis_warns_once_per_backend(recwarn):
+    import stoke_tpu.telemetry.attribution as attr
+
+    class _NoCost:
+        def lower(self, *a):
+            return self
+
+        def cost_analysis(self):
+            raise RuntimeError("backend reports nothing")
+
+        def compile(self):
+            return self
+
+    try:
+        assert attr.cost_analysis_of(_NoCost(), backend="faketpu") is None
+        w1 = [w for w in recwarn.list
+              if "cost_analysis unavailable" in str(w.message)]
+        assert len(w1) == 1
+        # second call: negative result cached, NO second warning, and the
+        # fn is never lowered again
+        class _Explodes:
+            def lower(self, *a):
+                raise AssertionError("must not re-lower a known-bad backend")
+
+        assert attr.cost_analysis_of(_Explodes(), backend="faketpu") is None
+        w2 = [w for w in recwarn.list
+              if "cost_analysis unavailable" in str(w.message)]
+        assert len(w2) == 1
+    finally:
+        attr._COST_UNAVAILABLE_BACKENDS.discard("faketpu")
+
+
+def test_zero_flop_program_does_not_blacklist_backend():
+    """XLA omits zero-valued cost properties, so a cost dict WITHOUT a
+    'flops' key is a program property (zero-FLOP program), not a backend
+    failure — it must not poison the process-wide negative cache."""
+    import stoke_tpu.telemetry.attribution as attr
+
+    class _ZeroFlops:
+        def lower(self, *a):
+            return self
+
+        def cost_analysis(self):
+            return {"bytes accessed": 5.0}
+
+    cost = attr.cost_analysis_of(_ZeroFlops(), backend="fakezero")
+    assert cost == {"bytes accessed": 5.0}
+    assert "fakezero" not in attr._COST_UNAVAILABLE_BACKENDS
+
+
+# --------------------------------------------------------------------------- #
+# auto-capture: trigger, bound count, health-registry integration
+# --------------------------------------------------------------------------- #
+
+
+def test_auto_capture_triggers_and_is_bounded(tmp_path, devices):
+    trace_dir = tmp_path / "traces"
+    s = _make_stoke(
+        tmp_path,
+        attr_over=dict(
+            auto_capture=True,
+            capture_mfu_below=0.999,   # toy CPU MFU is far below this
+            capture_step_zscore=0.0,   # disable the z trigger
+            capture_warmup_windows=2,
+            capture_steps=1,
+            max_captures=2,
+        ),
+        configs_extra=(ProfilerConfig(trace_dir=str(trace_dir)),),
+    )
+    rng = np.random.default_rng(7)
+    for x, y in _batches(8, rng):
+        s.train_step(x, (y,))
+    s.close_telemetry()
+    mon = s.attribution
+    assert mon.captures == 2  # bounded by max_captures despite 8 windows
+    assert len(mon._capture_dirs) == 2
+    for d in mon._capture_dirs:
+        assert os.path.isdir(d)
+        assert str(d).startswith(str(trace_dir))
+    assert (
+        s.telemetry.registry.get("attr/captures_total").value == 2
+    )
+    g = s.goodput
+    assert g["captures"] == 2 and len(g["capture_dirs"]) == 2
+
+
+def test_auto_capture_registers_as_health_detector(tmp_path, devices):
+    trace_dir = tmp_path / "traces"
+    s = _make_stoke(
+        tmp_path,
+        attr_over=dict(
+            auto_capture=True,
+            capture_mfu_below=0.999,
+            capture_step_zscore=0.0,
+            capture_warmup_windows=1,
+            capture_steps=1,
+            max_captures=1,
+        ),
+        configs_extra=(
+            ProfilerConfig(trace_dir=str(trace_dir)),
+            HealthConfig(dump_signals=False),
+        ),
+    )
+    assert any(
+        isinstance(d, AutoCaptureDetector) for d in s.health.detectors
+    )
+    rng = np.random.default_rng(8)
+    for x, y in _batches(5, rng):
+        s.train_step(x, (y,))
+    s.close_telemetry()
+    assert s.attribution.captures == 1
+    # the capture surfaced in the anomaly stream through the registry
+    assert s.health.anomaly_counts_by_detector().get(
+        "attribution_capture"
+    ) == 1
+
+
+def test_step_time_zscore_trigger(tmp_path):
+    """The z-score trigger on synthetic window times (no Stoke needed):
+    steady windows never fire; a 10x spike does."""
+    from stoke_tpu.telemetry.attribution import AttributionMonitor
+    from stoke_tpu.telemetry.registry import MetricsRegistry
+
+    cfg = AttributionConfig(
+        peak_tflops=1.0, auto_capture=True, capture_mfu_below=0.0,
+        capture_step_zscore=3.0, capture_warmup_windows=3,
+        capture_steps=1, max_captures=1, ema_alpha=0.2,
+    )
+    mon = AttributionMonitor(
+        cfg, MetricsRegistry(), trace_dir=str(tmp_path / "tr")
+    )
+    for step in range(1, 11):
+        mon.window_stats(
+            step=step, wall_s=0.1 + 0.001 * (step % 2),
+            host_dispatch_s=0.0, loader_wait_s=0.0, ckpt_io_s=0.0,
+            comm_bytes_onwire=None,
+        )
+    assert mon.captures == 0
+    mon.window_stats(
+        step=11, wall_s=1.0, host_dispatch_s=0.0, loader_wait_s=0.0,
+        ckpt_io_s=0.0, comm_bytes_onwire=None,
+    )
+    assert mon.captures == 1
+    trig = mon.consume_trigger()
+    assert trig is not None and "z=" in trig["reason"]
+    assert mon.consume_trigger() is None  # one-shot
+    mon.close()
+
+
+# --------------------------------------------------------------------------- #
+# goodput ledger details
+# --------------------------------------------------------------------------- #
+
+
+def test_goodput_recompile_bucket_charged_on_shape_churn(tmp_path, devices):
+    """A window containing a structural recompile charges its compile
+    time to the recompile bucket, not the (warm-up) compile bucket."""
+    s = _make_stoke(tmp_path)
+    rng = np.random.default_rng(9)
+    x, y = _batches(1, rng, batch=32)[0]
+    s.train_step(x, (y,))          # warm-up compile -> compile bucket
+    x2, y2 = _batches(1, rng, batch=16)[0]
+    s.train_step(x2, (y2,))        # new shape -> recompile bucket
+    s.close_telemetry()
+    recs = read_step_events(
+        os.path.join(str(tmp_path / "run" / "telemetry"), "steps.jsonl")
+    )
+    assert recs[0]["goodput_compile_s"] > 0
+    assert recs[0]["goodput_recompile_s"] == 0
+    assert recs[1]["recompiles"] == 1
+    assert recs[1]["goodput_recompile_s"] > 0
+    assert recs[1]["goodput_compile_s"] == 0
+
+
+def test_bundle_contains_goodput_and_cost_cards(tmp_path, devices):
+    s = _make_stoke(
+        tmp_path, configs_extra=(HealthConfig(dump_signals=False),)
+    )
+    rng = np.random.default_rng(10)
+    for x, y in _batches(2, rng):
+        s.train_step(x, (y,))
+    bundle = s.health.dump("attribution-test")
+    s.close_telemetry()
+    files = set(os.listdir(bundle))
+    assert {"goodput.json", "cost_cards.json"} <= files
+    goodput = json.load(open(os.path.join(bundle, "goodput.json")))
+    assert goodput["windows"] == 2
+    assert goodput["goodput_fraction"] is not None
+    cards = json.load(open(os.path.join(bundle, "cost_cards.json")))
+    assert cards and all(c["flops"] > 0 for c in cards)
+    assert any(c["program"] == "fused" for c in cards)
