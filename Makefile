@@ -7,7 +7,7 @@
 CPU_ENV = env PYTHONPATH=$(CURDIR) JAX_PLATFORMS=cpu
 MESH_ENV = $(CPU_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-full test-fast test-telemetry test-collectives test-health test-attribution dryrun bench-smoke telemetry-smoke tpu-probe
+.PHONY: test test-full test-fast test-telemetry test-collectives test-health test-attribution test-fleet dryrun bench-smoke telemetry-smoke tpu-probe
 
 test:            ## default tier (excludes @slow compile-heavy equivalence tests)
 	$(MESH_ENV) python -m pytest tests/ -x -q
@@ -32,6 +32,9 @@ test-health:     ## health-monitor tests only (sentinels/detectors/watchdog/reco
 
 test-attribution: ## step-time attribution tests only (CostCards/MFU/goodput/auto-capture)
 	$(MESH_ENV) python -m pytest tests/ -x -q -m attribution
+
+test-fleet:      ## fleet-observability tests only (skew aggregation/stragglers/barrier attribution)
+	$(MESH_ENV) python -m pytest tests/ -x -q -m fleet
 
 bench-smoke:     ## CPU-safe bench smoke (never touches the tunnel)
 	$(CPU_ENV) python bench.py --preset tiny

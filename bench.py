@@ -172,7 +172,8 @@ REGRESSION_TOLERANCE = 0.05
 #: as cross-configuration (A/B arms, seg sweeps) rather than a like-for-like
 #: regression
 _REGRESSION_CONFIG_KEYS = (
-    "xla_flags", "steps_per_dispatch", "comm_dtype", "health", "attribution"
+    "xla_flags", "steps_per_dispatch", "comm_dtype", "health",
+    "attribution", "fleet",
 )
 
 
@@ -457,12 +458,22 @@ def main():
                     "plus one cost-analysis per compiled program, but still "
                     "a distinct configuration for the stale-substitution "
                     "guard")
+    ap.add_argument("--fleet", action="store_true",
+                    help="enable fleet observability (ISSUE 5) on the "
+                    "measured run: per-window packed-signal exchange, "
+                    "cross-host skew aggregation, barrier-wait "
+                    "attribution.  On one chip the fleet is one host and "
+                    "this measures the monitor's own overhead; on a pod "
+                    "the ledger descriptor records the skew columns.  A "
+                    "distinct configuration for the stale-substitution "
+                    "and regression guards")
     ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
     if not args._worker:
         sys.exit(_supervise(
             sys.argv[1:], args.preset,
             requested={
+                "fleet": True if args.fleet else None,
                 "health": True if args.health else None,
                 "attribution": (
                     True if args.attribution_peak_tflops else None
@@ -521,11 +532,11 @@ def main():
     run_configs = []
     if args.comm_dtype:
         run_configs.append(CommConfig(dtype=args.comm_dtype))
-    if args.health or args.attribution_peak_tflops:
-        # health (ISSUE 3) / attribution (ISSUE 4) arms both ride the
-        # telemetry pipeline (status-validated requirement) — JSONL only,
-        # quiet cadence, no device-time sampling, so the monitor itself
-        # is the only perturbation being measured.
+    if args.health or args.attribution_peak_tflops or args.fleet:
+        # health (ISSUE 3) / attribution (ISSUE 4) / fleet (ISSUE 5) arms
+        # all ride the telemetry pipeline (status-validated requirement)
+        # — JSONL only, quiet cadence, no device-time sampling, so the
+        # monitor itself is the only perturbation being measured.
         import tempfile
 
         from stoke_tpu import TelemetryConfig
@@ -548,6 +559,14 @@ def main():
         run_configs.append(AttributionConfig(
             peak_tflops=args.attribution_peak_tflops,
         ))
+    if args.fleet:
+        # fleet arm (ISSUE 5): one packed-signal exchange per logged
+        # window; the ledger descriptor records the skew columns (on a
+        # single chip the fleet is one host and every skew is zero — the
+        # arm then measures the monitor's own overhead)
+        from stoke_tpu import FleetConfig
+
+        run_configs.append(FleetConfig(window_steps=10))
     stoke = Stoke(
         model=model,
         optimizer=StokeOptimizer(
@@ -671,7 +690,26 @@ def main():
             for b in ("productive", "compile", "recompile", "loader",
                       "checkpoint", "halt")
         }
-    if args.health or args.attribution_peak_tflops:
+    if args.fleet:
+        # skew columns (ISSUE 5): the fleet view of the measured run —
+        # window count, hosts, worst per-host lag, straggler verdicts
+        f = stoke.fleet_summary or {}
+        verdict = f.get("last_verdict") or {}
+        result["fleet"] = True
+        result["fleet_hosts"] = f.get("n_processes")
+        result["fleet_windows"] = f.get("windows")
+        result["fleet_straggler_windows"] = f.get("straggler_windows")
+        result["fleet_straggler_anomalies"] = f.get("straggler_anomalies")
+        result["fleet_last_lag_frac"] = (
+            None if verdict.get("lag_frac") is None
+            else round(verdict["lag_frac"], 4)
+        )
+        result["fleet_last_skew_class"] = verdict.get("skew_class")
+        result["fleet_barrier_wait_s"] = (
+            None if verdict.get("barrier_wait_s") is None
+            else round(verdict["barrier_wait_s"], 4)
+        )
+    if args.health or args.attribution_peak_tflops or args.fleet:
         stoke.close_telemetry()
     if on_accel:
         regression = check_regression(
@@ -685,6 +723,7 @@ def main():
                 "attribution": (
                     True if args.attribution_peak_tflops else None
                 ),
+                "fleet": True if args.fleet else None,
             },
         )
         if regression is not None:
@@ -722,6 +761,22 @@ def main():
                         "health_anomalies": result["health_anomalies"],
                     }
                     if args.health
+                    else {}
+                ),
+                **(
+                    {
+                        "fleet": True,
+                        "fleet_hosts": result["fleet_hosts"],
+                        "fleet_windows": result["fleet_windows"],
+                        "fleet_straggler_windows": result[
+                            "fleet_straggler_windows"
+                        ],
+                        "fleet_last_lag_frac": result["fleet_last_lag_frac"],
+                        "fleet_last_skew_class": result[
+                            "fleet_last_skew_class"
+                        ],
+                    }
+                    if args.fleet
                     else {}
                 ),
                 **(

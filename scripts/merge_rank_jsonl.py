@@ -1,0 +1,296 @@
+"""Merge/align per-rank telemetry JSONL streams into a per-host skew table.
+
+The offline twin of the in-band fleet view (ISSUE 5): a run with
+``TelemetryConfig(jsonl_all_ranks=True)`` leaves one ``steps.rank<N>.jsonl``
+per process; this tool aligns them by optimizer step and prints, per logged
+window, each host's wall time / loader wait / dispatch time skew vs the
+fleet median plus a straggler verdict — the same math
+(``stoke_tpu.telemetry.fleet.straggler_verdict``) the live exchange runs,
+usable on bundles salvaged from DEAD runs where the in-band view never got
+to report.
+
+Usage (CPU-safe; never touches an accelerator):
+
+    env PYTHONPATH=. JAX_PLATFORMS=cpu \
+        python scripts/merge_rank_jsonl.py <dir-or-files...> [--json]
+        [--rel-threshold 0.25] [--zscore 3.0] [--no-validate]
+
+``<dir>`` is scanned for ``steps.rank*.jsonl``; explicit file paths are
+taken as one stream per rank (rank parsed from the name, else positional).
+Exit 0 when streams merged cleanly, 2 when nothing could be aligned.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_RANK_RE = re.compile(r"steps\.rank(\d+)\.jsonl$")
+
+
+def discover_streams(paths: List[str]) -> List[Tuple[int, str]]:
+    """``[(rank, path), ...]`` from a mix of directories and files.
+
+    Two files PARSING to the same rank (e.g. two runs' ``steps.rank0.
+    jsonl``) raise — silently extending one host's stream with another's
+    would compute the skew table over a chimera host.  Unnamed files
+    (``steps.jsonl``) carry no rank claim and are assigned the next free
+    index; mixing files from different runs is on the caller."""
+    out: List[Tuple[int, str]] = []
+    used: set = set()
+    fallback = 0
+    for p in paths:
+        files = (
+            sorted(glob.glob(os.path.join(p, "steps.rank*.jsonl")))
+            if os.path.isdir(p)
+            else [p]
+        )
+        if os.path.isdir(p) and not files:
+            # single-rank runs write steps.jsonl — still mergeable (a
+            # fleet of one, skew table degenerates to a timeline)
+            solo = os.path.join(p, "steps.jsonl")
+            if os.path.exists(solo):
+                files = [solo]
+        for f in files:
+            m = _RANK_RE.search(os.path.basename(f))
+            if m:
+                rank = int(m.group(1))
+                if rank in used:
+                    raise ValueError(
+                        f"{f}: rank {rank} already provided by another "
+                        f"stream — merging two hosts' files into one "
+                        f"rank would corrupt the skew table (pass one "
+                        f"run's files at a time)"
+                    )
+            else:
+                while fallback in used:
+                    fallback += 1
+                rank = fallback
+            used.add(rank)
+            out.append((rank, f))
+    out.sort()
+    return out
+
+
+def load_stream(path: str, validate: bool) -> List[Dict[str, Any]]:
+    from stoke_tpu.telemetry.events import read_step_events
+
+    return read_step_events(path, validate=validate)
+
+
+def align_by_step(
+    streams: Dict[int, List[Dict[str, Any]]],
+) -> List[Tuple[int, Dict[int, Dict[str, Any]]]]:
+    """``[(step, {rank: record})]`` for steps present in EVERY stream —
+    a rank missing a step (crashed mid-window, clock-skewed cadence) is
+    reported, not silently padded."""
+    by_rank_step = {
+        rank: {r["step"]: r for r in recs} for rank, recs in streams.items()
+    }
+    common = set.intersection(*(set(d) for d in by_rank_step.values()))
+    return [
+        (step, {rank: by_rank_step[rank][step] for rank in by_rank_step})
+        for step in sorted(common)
+    ]
+
+
+def window_matrix(
+    rows: Dict[int, Dict[str, Any]],
+    prev: Optional[Dict[int, Dict[str, Any]]],
+) -> "Any":
+    """Per-host fleet matrix for one aligned window.  Wall time is the ts
+    delta to the rank's previous aligned record (the live view's window
+    wall); barrier wait is not in the step events, so that column is zero
+    and the verdict runs on wall + loader skew alone."""
+    import numpy as np
+
+    from stoke_tpu.telemetry.fleet import FLEET_INDEX, N_FLEET_SIGNALS
+
+    ranks = sorted(rows)
+    m = np.zeros((len(ranks), N_FLEET_SIGNALS), np.float64)
+    for i, rank in enumerate(ranks):
+        r = rows[rank]
+        m[i, FLEET_INDEX["step"]] = r["step"]
+        if prev is not None and rank in prev:
+            m[i, FLEET_INDEX["wall_s"]] = max(
+                r["ts"] - prev[rank]["ts"], 0.0
+            )
+            # compile_time_s in step events is run-cumulative; the wire
+            # format's compile_s slot is per-window — delta like wall
+            m[i, FLEET_INDEX["compile_s"]] = max(
+                (r.get("compile_time_s") or 0.0)
+                - (prev[rank].get("compile_time_s") or 0.0),
+                0.0,
+            )
+        m[i, FLEET_INDEX["loader_wait_s"]] = r.get("loader_wait_s") or 0.0
+        m[i, FLEET_INDEX["comm_bytes_onwire"]] = (
+            r.get("comm_bytes_onwire") or 0.0
+        )
+        m[i, FLEET_INDEX["health_anomalies"]] = (
+            r.get("health_anomalies") or 0.0
+        )
+    return m
+
+
+def merge(
+    streams: Dict[int, List[Dict[str, Any]]],
+    rel_threshold: float,
+    zscore: float,
+) -> Dict[str, Any]:
+    """The full offline fleet report: one verdict row per aligned window
+    (the first window has no wall baseline and is skipped), plus per-host
+    cumulative totals and the modal straggler."""
+    from stoke_tpu.telemetry.fleet import straggler_verdict
+
+    aligned = align_by_step(streams)
+    ranks = sorted(streams)
+    windows: List[Dict[str, Any]] = []
+    prev: Optional[Dict[int, Dict[str, Any]]] = None
+    for step, rows in aligned:
+        if prev is not None:
+            matrix = window_matrix(rows, prev)
+            v = straggler_verdict(
+                matrix, rel_threshold=rel_threshold,
+                zscore_threshold=zscore,
+            )
+            v["step"] = step
+            # map matrix row index back to the actual rank id
+            v["host"] = ranks[v["host"]]
+            if v["barrier_charged_host"] is not None:
+                v["barrier_charged_host"] = ranks[v["barrier_charged_host"]]
+            windows.append(v)
+        prev = rows
+    totals = {
+        rank: {
+            "records": len(recs),
+            "loader_wait_s": sum(r.get("loader_wait_s") or 0.0 for r in recs),
+            "host_dispatch_s": sum(
+                r.get("host_dispatch_s") or 0.0 for r in recs
+            ),
+            "compile_time_s": (
+                (recs[-1].get("compile_time_s") or 0.0) if recs else 0.0
+            ),
+        }
+        for rank, recs in streams.items()
+    }
+    flagged = [w for w in windows if w["flagged"]]
+    modal = None
+    if flagged:
+        counts: Dict[int, int] = {}
+        for w in flagged:
+            counts[w["host"]] = counts.get(w["host"], 0) + 1
+        modal = max(counts, key=counts.get)
+    return {
+        "hosts": ranks,
+        "aligned_windows": len(windows),
+        "unaligned_steps": {
+            rank: len(recs) - len(aligned)
+            for rank, recs in streams.items()
+        },
+        "windows": windows,
+        "per_host_totals": totals,
+        "flagged_windows": len(flagged),
+        "modal_straggler": modal,
+    }
+
+
+def print_table(report: Dict[str, Any]) -> None:
+    hdr = (
+        f"{'step':>8} {'hosts':>5} {'wall_med':>9} {'wall_max':>9} "
+        f"{'lag_s':>8} {'lag%':>6} {'straggler':>9} {'class':>8}"
+    )
+    print(hdr)
+    print("-" * len(hdr))
+    for w in report["windows"]:
+        print(
+            f"{w['step']:>8} {w['hosts']:>5} "
+            f"{w['wall_median_s']:>9.3f} {w['wall_max_s']:>9.3f} "
+            f"{w['lag_s']:>8.3f} {100 * w['lag_frac']:>5.1f}% "
+            f"{(str(w['host']) if w['flagged'] else '-'):>9} "
+            f"{w['skew_class']:>8}"
+        )
+    print()
+    print(
+        f"{report['aligned_windows']} aligned windows across "
+        f"{len(report['hosts'])} hosts; {report['flagged_windows']} flagged"
+        + (
+            f"; modal straggler: host {report['modal_straggler']}"
+            if report["modal_straggler"] is not None
+            else ""
+        )
+    )
+    for rank, t in sorted(report["per_host_totals"].items()):
+        print(
+            f"  host {rank}: {t['records']} records, "
+            f"loader_wait {t['loader_wait_s']:.3f}s, "
+            f"dispatch {t['host_dispatch_s']:.3f}s, "
+            f"compile {t['compile_time_s']:.3f}s"
+        )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="align per-rank steps.rank<N>.jsonl streams into a "
+        "per-host skew table (the offline fleet view)"
+    )
+    ap.add_argument("paths", nargs="+",
+                    help="telemetry output dir(s) or explicit jsonl files")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as one JSON document")
+    ap.add_argument("--rel-threshold", type=float, default=0.25,
+                    help="lag/median-wall fraction flagging a straggler")
+    ap.add_argument("--zscore", type=float, default=3.0,
+                    help="cross-host lag z-score flagging a straggler")
+    ap.add_argument("--no-validate", action="store_true",
+                    help="skip step-event schema validation (salvaging "
+                    "truncated streams from dead runs)")
+    args = ap.parse_args(argv)
+
+    try:
+        found = discover_streams(args.paths)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if not found:
+        print("no steps*.jsonl streams found", file=sys.stderr)
+        return 2
+    streams: Dict[int, List[Dict[str, Any]]] = {}
+    for rank, path in found:
+        try:
+            recs = load_stream(path, validate=not args.no_validate)
+        except (OSError, ValueError) as e:
+            # typo'd/deleted/unreadable paths are the dead-run-salvage
+            # norm: report and keep merging what IS readable
+            print(f"skipping {path}: {e}", file=sys.stderr)
+            continue
+        if recs:
+            streams.setdefault(rank, []).extend(recs)
+    if not streams:
+        print("no readable records in any stream", file=sys.stderr)
+        return 2
+    report = merge(streams, args.rel_threshold, args.zscore)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print_table(report)
+    if report["aligned_windows"] == 0:
+        # streams loaded but share no steps (disjoint cadences, or one
+        # truncated before the other began) — "nothing could be aligned"
+        # is the documented nonzero-exit condition
+        print(
+            "no step is present in every stream; nothing aligned",
+            file=sys.stderr,
+        )
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

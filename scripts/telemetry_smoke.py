@@ -25,6 +25,7 @@ def main() -> int:
 
     from stoke_tpu import (
         AttributionConfig,
+        FleetConfig,
         HealthConfig,
         Stoke,
         StokeOptimizer,
@@ -48,6 +49,9 @@ def main() -> int:
     # MFU / goodput path on CPU — peak is arbitrary here, only the
     # plumbing is being proven
     acfg = AttributionConfig(peak_tflops=1.0, peak_hbm_gbps=100.0)
+    # fleet view (ISSUE 5): one exchange window end-to-end — a fleet of
+    # one host on CPU, proving the packed-vector/aggregation/JSONL path
+    fcfg = FleetConfig(window_steps=1)
     stoke = Stoke(
         model=lambda p, x: x @ p["w"],
         optimizer=StokeOptimizer(
@@ -56,11 +60,14 @@ def main() -> int:
         loss=lambda o, y: ((o - y) ** 2).mean(),
         params={"w": np.ones((8, 4), np.float32)},
         batch_size_per_device=16,
-        configs=[cfg, hcfg, acfg],
+        configs=[cfg, hcfg, acfg, fcfg],
         verbose=False,
     )
     x = np.ones((16, 8), np.float32)
     y = np.zeros((16, 4), np.float32)
+    stoke.train_step(x, (y,))
+    # second step: the fleet view anchors its cadence on the first record
+    # (warm-up discard) and closes its first exchange window on the next
     stoke.train_step(x, (y,))
     # forced post-mortem dump: the bundle a human reads after a crash —
     # exercised end-to-end so the crash path is proven BEFORE the crash
@@ -90,12 +97,23 @@ def main() -> int:
         and goodput.get("windows", 0) >= 1
         and goodput.get("goodput_fraction") is not None
     )
+    # ISSUE 5: the fleet window populated the per-host view (a fleet of
+    # one here: skew zero, class "none") and the end-of-run summary
+    fleet = stoke.fleet_summary or {}
+    fleet_ok = (
+        rec.get("fleet/hosts") == 1
+        and rec.get("fleet/window", 0) >= 1
+        and rec.get("fleet/skew_class") == "none"
+        and fleet.get("windows", 0) >= 1
+    )
     bundle_files = set(os.listdir(bundle)) if os.path.isdir(bundle) else set()
     bundle_ok = {
         "manifest.json", "ring.jsonl", "config.json", "mesh.json",
         "environment.json", "stacks.txt",
         # ISSUE 4: utilization at time of death rides every bundle
         "goodput.json", "cost_cards.json",
+        # ISSUE 5: which host was slow at time of death
+        "fleet.json",
     } <= bundle_files
     ring_kinds = set()
     if bundle_ok:
@@ -109,14 +127,18 @@ def main() -> int:
     ]
     tb_events = read_scalar_events(tb_files[0]) if tb_files else []
     ok = (
-        len(records) == 1
+        len(records) == 2
         and records[0]["step"] == 1
         and health_fields_ok
         and attribution_ok
+        and fleet_ok
         and "stoke_jax_compiles_total" in prom
         and "stoke_health_anomalies_total" in prom
         and "stoke_goodput_productive_s_total" in prom
         and "stoke_attr_mfu" in prom
+        and "stoke_fleet_windows_total" in prom
+        and "stoke_sync_barriers_total" in prom
+        and 'host="' in prom  # multi-host scrape-collision labels
         and any(t.startswith("telemetry/") for t, _, _ in tb_events)
         and bundle_ok
         and {"sentinels", "step_event"} <= ring_kinds
@@ -133,6 +155,9 @@ def main() -> int:
         "mfu": rec.get("mfu"),
         "bound": rec.get("bound"),
         "goodput_fraction": goodput.get("goodput_fraction"),
+        "fleet_hosts": rec.get("fleet/hosts"),
+        "fleet_windows": fleet.get("windows"),
+        "fleet_skew_class": rec.get("fleet/skew_class"),
     }))
     return 0 if ok else 1
 

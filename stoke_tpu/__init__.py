@@ -18,6 +18,7 @@ from stoke_tpu.configs import (
     DeviceOptions,
     DistributedInitConfig,
     DistributedOptions,
+    FleetConfig,
     FSDPConfig,
     HealthConfig,
     LossReduction,
@@ -87,6 +88,7 @@ __all__ = [
     "DistributedInitConfig",
     "OSSConfig",
     "SDDPConfig",
+    "FleetConfig",
     "FSDPConfig",
     "HealthConfig",
     "OffloadDiskConfig",

@@ -621,6 +621,11 @@ class TelemetryConfig:
         jsonl_all_ranks: multi-host — every process writes its own
             ``steps.rank<N>.jsonl`` (default: rank 0 only, like all sinks).
         prometheus: write the atomic text-exposition scrape file.
+        prometheus_all_ranks: multi-host — every process writes its own
+            ``metrics.rank<N>.prom`` so each host's node exporter can
+            scrape its local file (expositions carry ``host`` /
+            ``process_index`` labels, so the aggregated series never
+            collide — the fleet-skew view's Prometheus leg, ISSUE 5).
         tensorboard: mirror step events into a native TB event stream
             under ``output_dir/tb`` (independent of ``TensorboardConfig``,
             which keeps driving the legacy loss/scaler scalars).
@@ -643,6 +648,7 @@ class TelemetryConfig:
     jsonl: bool = True
     jsonl_all_ranks: bool = False
     prometheus: bool = True
+    prometheus_all_ranks: bool = False
     tensorboard: bool = False
     sample_device_time: bool = True
     grad_norm: bool = False
@@ -838,6 +844,84 @@ class AttributionConfig:
     capture_action: str = "record"
 
 
+#: straggler-detector actions FleetConfig accepts (validated by status.py;
+#: "halt" is deliberately excluded — a slow host is a performance
+#: diagnosis, never a reason to kill the run)
+FLEET_ACTIONS: Tuple[str, ...] = ("record", "warn", "dump")
+
+
+@dataclass
+class FleetConfig:
+    """Fleet observability (ISSUE 5 tentpole): cross-host skew
+    aggregation, straggler detection, and barrier-wait attribution.
+
+    Requires a :class:`TelemetryConfig` (the fleet view surfaces through
+    the JSONL step events and Prometheus exposition; status-validated).
+    Default OFF — without this config the step paths, compiled programs,
+    and telemetry records are untouched, and a single-process run with it
+    on performs no collective at all (a fleet of one).
+
+    With it on, every ``window_steps`` optimizer steps each host packs a
+    small fixed-layout vector of window-local signals (step wall time,
+    dispatch count, loader wait, starvation, compile time, barrier wait,
+    goodput buckets, health-anomaly count, comm bytes —
+    ``stoke_tpu.telemetry.fleet.FLEET_SIGNALS``) and ONE tiny in-band
+    ``process_allgather`` (piggybacked on the telemetry record cadence;
+    zero extra dispatches on the compiled step path) gives every host the
+    full per-host matrix.  From it the run derives min/median/max/p99 +
+    argmax-host per signal (``fleet/*`` Prometheus gauges), per-host
+    step-time skew vs the fleet median, a loader-vs-compute skew
+    classification, and barrier-wait attribution (wait charged to the
+    straggler that arrived last, not the waiters) — emitted into the
+    JSONL step events (``fleet/*`` fields), the end-of-run
+    ``Stoke.fleet_summary``, and flight-recorder bundles (per-host matrix
+    + straggler verdict at time of death).  MLPerf-scale motivation:
+    per-host input and step-time skew dominate lost pod scaling
+    (arXiv:1909.09756).
+
+    Like every cross-host collective, the exchange assumes all hosts
+    keep stepping: if one rank stops (a rank-local ``halt``-action
+    health detector, a crash without process teardown) the others block
+    in the next exchange until the runtime notices — on pods, pair with
+    ``HealthConfig(watchdog=True)`` so a wedged exchange trips the hang
+    watchdog instead of hanging silently.
+
+    Attributes:
+        window_steps: optimizer steps per fleet exchange window (>= 1;
+            the exchange fires at the first telemetry record crossing
+            each boundary, so the effective cadence is
+            ``max(window_steps, TelemetryConfig.log_every_n_steps)``).
+            The very first record only anchors the cadence and is
+            discarded — its wall covers init-to-now warm-up compiles,
+            whose per-host skew would pollute the first verdict — so the
+            first exchange happens at the second boundary crossing.
+        straggler_zscore: leave-one-out z-score of a host's lag
+            (step-time skew + loader skew + barrier lateness) against
+            the rest of the fleet above which the host is flagged
+            (> 0; live on fleets of >= 3 hosts — with 2 hosts only the
+            relative threshold below applies.  Leave-one-out because an
+            all-host z-score is bounded by sqrt(n_hosts - 1) and a
+            3-sigma threshold could never fire on small fleets).
+        straggler_rel_frac: lag as a fraction of the fleet-median window
+            wall time above which the host is flagged (> 0; fleet-size
+            independent).
+        straggler_windows: consecutive flagged windows on the SAME host
+            before the ``fleet_straggler`` detector fires (>= 1; fires
+            once per streak, then re-arms).
+        straggler_action: what a firing does — ``record`` (count only),
+            ``warn`` (count + warning), ``dump`` (count + post-mortem
+            bundle; requires a ``HealthConfig`` whose recorder writes
+            it, otherwise degrades to warn).  Validated against
+            ``FLEET_ACTIONS``.
+    """
+
+    window_steps: int = 10
+    straggler_zscore: float = 3.0
+    straggler_rel_frac: float = 0.25
+    straggler_windows: int = 3
+    straggler_action: str = "warn"
+
+
 @dataclass
 class ProfilerConfig:
     """First-class profiling (SURVEY.md §5: native win over the reference's
@@ -895,6 +979,7 @@ ALL_CONFIG_CLASSES: Tuple[type, ...] = (
     PartitionRulesConfig,
     ActivationCheckpointingConfig,
     CheckpointConfig,
+    FleetConfig,
     HealthConfig,
     ProfilerConfig,
     TelemetryConfig,

@@ -458,7 +458,8 @@ class Stoke:
         #       recorder + watchdog; default OFF — without a HealthConfig
         #       the step paths are untouched) -----
         self._health: Optional[HealthMonitor] = None
-        self._last_sentinels = None
+        self._fleet = None  # assigned below; the recorder's fleet_fn
+        self._last_sentinels = None  # closure may fire before then
         hcfg = st.health_config
         if hcfg is not None:
             bundle_dir = hcfg.bundle_dir
@@ -489,6 +490,15 @@ class Stoke:
                     if self._attribution is not None
                     else None
                 ),
+                # ISSUE 5: late-bound — the fleet monitor is constructed
+                # after the health block so it can see the full registry;
+                # bundles written before the first exchange carry no
+                # fleet.json (snapshot() of a monitor-less run is None)
+                fleet_fn=lambda: (
+                    self._fleet.snapshot()
+                    if self._fleet is not None
+                    else None
+                ),
             )
             self._health = HealthMonitor(
                 hcfg,
@@ -507,6 +517,35 @@ class Stoke:
                 self._health.detectors.append(
                     AutoCaptureDetector(
                         self._attribution, acfg.capture_action
+                    )
+                )
+
+        # ----- fleet observability (ISSUE 5: cross-host skew aggregation,
+        #       straggler detection, barrier-wait attribution; default OFF
+        #       — without a FleetConfig no cross-host exchange ever runs
+        #       and the step paths are untouched) -----
+        fcfg = st.fleet_config
+        if fcfg is not None:
+            from stoke_tpu.telemetry.fleet import (
+                FleetMonitor,
+                FleetStragglerDetector,
+            )
+
+            self._fleet = FleetMonitor(
+                fcfg,
+                self._telemetry.registry,
+                rank=jax.process_index(),
+                n_processes=jax.process_count(),
+                dispatch_count_fn=lambda: self._engine.dispatch_count,
+            )
+            self._telemetry.fleet = self._fleet
+            if self._health is not None:
+                # the straggler streak surfaces as a health anomaly
+                # (PR 3 registry): counted, ringed, and bundled like any
+                # other detector firing
+                self._health.detectors.append(
+                    FleetStragglerDetector(
+                        self._fleet, fcfg.straggler_action
                     )
                 )
 
@@ -1197,6 +1236,19 @@ class Stoke:
         return self._telemetry.goodput_summary()
 
     @property
+    def fleet(self):
+        """The run's fleet monitor (None without a ``FleetConfig``) —
+        per-host signal matrix, skew aggregates, straggler streak state."""
+        return self._fleet
+
+    @property
+    def fleet_summary(self) -> Optional[Dict[str, Any]]:
+        """End-of-run fleet accounting: exchange windows, the latest
+        per-host signal matrix + aggregates + straggler verdict, and the
+        straggler counts.  None without a ``FleetConfig``."""
+        return self._telemetry.fleet_summary()
+
+    @property
     def dispatch_count(self) -> int:
         """Compiled-program invocations issued by this run's engine (the
         health acceptance counter: sentinels must not add dispatches)."""
@@ -1266,6 +1318,21 @@ class Stoke:
         """Flush + close the telemetry sinks and the health monitor
         (watchdog thread + signal handlers); idempotent — sinks are
         line-buffered/atomic, so skipping this loses at most nothing."""
+        if (
+            self._health is not None
+            and self._fleet is not None
+            and self._fleet._pending_straggler is not None
+        ):
+            # a straggler streak that completed on the run's FINAL window
+            # has no later step observation to drain it — run the
+            # detectors once more so the anomaly (and its dump bundle,
+            # for action='dump') is recorded instead of silently lost.
+            # Sentinel-driven detectors skip on None; a halt from a
+            # registry-driven detector must not raise out of shutdown.
+            try:
+                self._health.observe(self._optimizer_steps, None)
+            except HealthHaltError:
+                pass
         self._telemetry.close()
         if self._health is not None:
             self._health.close()
@@ -1736,11 +1803,21 @@ class Stoke:
     def barrier(self) -> None:
         """Cross-process sync (reference barrier/hvd.join,
         distributed.py:671-692).  In-step SPMD needs no barriers; this exists
-        for host-side coordination around IO."""
+        for host-side coordination around IO.
+
+        Instrumented (ISSUE 5 satellite): the elapsed wait — near zero for
+        the last arrival, the full skew for the first — lands in
+        ``sync/barrier_wait_s`` / ``sync/barriers_total`` of every live
+        telemetry registry, FleetConfig or not, so cross-process sync time
+        is visible in the wall-clock breakdown and (with a ``FleetConfig``)
+        chargeable to the straggler host."""
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
-            multihost_utils.sync_global_devices("stoke_barrier")
+            from stoke_tpu.telemetry.fleet import timed_sync
+
+            with timed_sync("barrier"):
+                multihost_utils.sync_global_devices("stoke_barrier")
 
     def block_until_ready(self) -> None:
         """Wait for all in-flight device work (bench/test helper)."""

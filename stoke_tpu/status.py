@@ -26,6 +26,7 @@ from stoke_tpu.configs import (
     ALL_CONFIG_CLASSES,
     COMM_DTYPES,
     COMM_STRATEGIES,
+    FLEET_ACTIONS,
     HEALTH_ACTIONS,
     ActivationCheckpointingConfig,
     AttributionConfig,
@@ -38,6 +39,7 @@ from stoke_tpu.configs import (
     DeviceOptions,
     DistributedInitConfig,
     DistributedOptions,
+    FleetConfig,
     FSDPConfig,
     MeshConfig,
     OffloadDiskConfig,
@@ -347,9 +349,11 @@ class StokeStatus:
                         f"TelemetryConfig.output_dir {cfg.output_dir!r} is "
                         f"not writable: {err}"
                     )
-                    # all-rank JSONL writes on every process: the error is
+                    # all-rank sinks write on every process: the error is
                     # fatal everywhere, not only on rank 0
-                    if cfg.jsonl and cfg.jsonl_all_ranks:
+                    if (cfg.jsonl and cfg.jsonl_all_ranks) or (
+                        cfg.prometheus and cfg.prometheus_all_ranks
+                    ):
                         return msg
                     return _rank0_only(msg)
             return False
@@ -555,6 +559,51 @@ class StokeStatus:
                 )
             return False
 
+        def _fleet_invalid(s):
+            """Fleet-observability legality (ISSUE 5): the fleet view
+            surfaces through the telemetry step events (so a
+            TelemetryConfig is required), the exchange window must be a
+            positive step count, the straggler thresholds must be able to
+            fire, and the detector action must be a known non-fatal one
+            (a slow host is a diagnosis, never a reason to halt)."""
+            cfg = self._configs.get("FleetConfig")
+            if cfg is None:
+                return False
+            if "TelemetryConfig" not in self._configs:
+                return (
+                    "FleetConfig requires a TelemetryConfig — the fleet "
+                    "view surfaces through the telemetry step events; add "
+                    "one or drop the config"
+                )
+            if cfg.window_steps < 1:
+                return (
+                    f"FleetConfig.window_steps must be >= 1, got "
+                    f"{cfg.window_steps}"
+                )
+            if cfg.straggler_zscore <= 0:
+                return (
+                    f"FleetConfig.straggler_zscore must be > 0, got "
+                    f"{cfg.straggler_zscore}"
+                )
+            if cfg.straggler_rel_frac <= 0:
+                return (
+                    f"FleetConfig.straggler_rel_frac must be > 0, got "
+                    f"{cfg.straggler_rel_frac}"
+                )
+            if cfg.straggler_windows < 1:
+                return (
+                    f"FleetConfig.straggler_windows must be >= 1, got "
+                    f"{cfg.straggler_windows}"
+                )
+            if cfg.straggler_action not in FLEET_ACTIONS:
+                return (
+                    f"FleetConfig.straggler_action "
+                    f"{cfg.straggler_action!r} unknown; valid: "
+                    f"{list(FLEET_ACTIONS)} (halt is not allowed — a "
+                    f"straggler is a performance diagnosis, not fatal)"
+                )
+            return False
+
         def _offload_cpu_no_fallback(s):
             for name in ("OffloadOptimizerConfig", "OffloadParamsConfig"):
                 cfg = self._configs.get(name)
@@ -687,6 +736,10 @@ class StokeStatus:
             (
                 _attribution_invalid,
                 "AttributionConfig is invalid for this combination",
+            ),
+            (
+                _fleet_invalid,
+                "FleetConfig is invalid for this combination",
             ),
             (
                 _offload_cpu_no_fallback,
@@ -912,6 +965,13 @@ class StokeStatus:
         opt-in; without it the step paths run no cost analysis and the
         compiled programs are bit-identical to pre-ISSUE-4)."""
         return self._configs.get("AttributionConfig")
+
+    @property
+    def fleet_config(self) -> Optional[FleetConfig]:
+        """None unless explicitly supplied (fleet observability is
+        opt-in; without it no cross-host exchange ever runs and the step
+        paths are bit-identical to pre-ISSUE-5)."""
+        return self._configs.get("FleetConfig")
 
     @property
     def telemetry_config(self) -> Optional[TelemetryConfig]:

@@ -65,6 +65,20 @@ from stoke_tpu.telemetry.attribution import (
     roofline_summary,
     roofline_time_s,
 )
+from stoke_tpu.telemetry.fleet import (
+    FLEET_EVENT_FIELDS,
+    FLEET_SIGNALS,
+    FleetMonitor,
+    FleetStragglerDetector,
+    fleet_aggregates,
+    observe_sync_wait,
+    pack_fleet_vector,
+    register_sync_registry,
+    straggler_verdict,
+    timed_sync,
+    unpack_fleet_vector,
+    unregister_sync_registry,
+)
 from stoke_tpu.telemetry.recorder import FlightRecorder
 from stoke_tpu.telemetry.registry import (
     Counter,
@@ -121,6 +135,19 @@ __all__ = [
     "cost_analysis_of",
     "roofline_summary",
     "roofline_time_s",
+    # fleet observability (ISSUE 5)
+    "FLEET_SIGNALS",
+    "FLEET_EVENT_FIELDS",
+    "FleetMonitor",
+    "FleetStragglerDetector",
+    "fleet_aggregates",
+    "straggler_verdict",
+    "pack_fleet_vector",
+    "unpack_fleet_vector",
+    "register_sync_registry",
+    "unregister_sync_registry",
+    "observe_sync_wait",
+    "timed_sync",
 ]
 
 
@@ -151,6 +178,15 @@ class Telemetry:
         # facade when an AttributionConfig is supplied; None keeps
         # record_step free of MFU/goodput computation entirely
         self.attribution = None
+        # fleet-view monitor (ISSUE 5) — assigned by the facade when a
+        # FleetConfig is supplied; None keeps record_step free of any
+        # cross-host exchange entirely
+        self.fleet = None
+        # cross-process sync timings (Stoke.barrier / checkpoint
+        # sync_global_devices) land in this registry even when no
+        # TelemetryConfig drives sinks — the wall-clock breakdown and
+        # the fleet barrier-wait attribution both read them
+        register_sync_registry(self.registry)
         self._last_record: Dict[str, float] = {}
         # seeded now so the FIRST record's rates cover init->record wall
         # time (includes warm-up compiles — honest, if conservative)
@@ -178,11 +214,25 @@ class Telemetry:
                 else f"steps.rank{self.rank}.jsonl"
             )
             self.sinks.append(JsonlSink(os.path.join(out, name)))
-        if config.prometheus and is_rank0:
+        if config.prometheus and (is_rank0 or config.prometheus_all_ranks):
+            from stoke_tpu.telemetry.sinks import host_labels
+
+            prom_name = (
+                "metrics.prom"
+                if is_rank0 and not config.prometheus_all_ranks
+                else f"metrics.rank{self.rank}.prom"
+            )
             self.sinks.append(
                 PrometheusSink(
-                    os.path.join(out, "metrics.prom"),
-                    labels={"rank": str(self.rank), "run": config.run_name},
+                    os.path.join(out, prom_name),
+                    # host/process_index labels (ISSUE 5 satellite): a
+                    # multi-host job's per-host expositions scraped into
+                    # one Prometheus must not collide into one series
+                    labels={
+                        "rank": str(self.rank),
+                        "run": config.run_name,
+                        **host_labels(self.rank),
+                    },
                 )
             )
         if config.tensorboard and is_rank0:
@@ -237,6 +287,12 @@ class Telemetry:
         for name in self.registry.names():
             if name.startswith("facade/") and name.endswith("_s"):
                 out[name[len("facade/"):-2]] = self.registry.get(name).value
+        # cross-process sync time (ISSUE 5 satellite): barrier waits are
+        # host wall clock like the facade phases, and invisible anywhere
+        # else a wall-clock reader looks — surface once any accrued
+        sync = self.registry.get("sync/barrier_wait_s")
+        if sync is not None and sync.value > 0:
+            out["sync/barrier_wait"] = sync.value
         if self.attribution is not None:
             summary = self.attribution.goodput_summary()
             for b in GOODPUT_BUCKETS:
@@ -250,6 +306,14 @@ class Telemetry:
         if self.attribution is None:
             return None
         return self.attribution.goodput_summary()
+
+    def fleet_summary(self) -> Optional[dict]:
+        """End-of-run fleet accounting (windows, latest per-host matrix +
+        aggregates + straggler verdict, straggler counts); None without a
+        ``FleetConfig``."""
+        if self.fleet is None:
+            return None
+        return self.fleet.summary()
 
     # ------------------------------------------------------------------ #
     # step records
@@ -376,6 +440,19 @@ class Telemetry:
                 comm_bytes_onwire=comm_wire,
             )
 
+        # fleet view (ISSUE 5): accumulate this record's deltas into the
+        # current fleet window; at a window boundary ONE in-band
+        # process_allgather yields the per-host matrix and the fleet/*
+        # fields below — between boundaries the fields ride as nulls
+        fleet_fields: Optional[dict] = None
+        if self.fleet is not None:
+            fleet_fields = self.fleet.window_stats(
+                step=step,
+                wall_s=wall_dt,
+                loader_wait_s=loader_wait,
+                comm_bytes_onwire=comm_wire,
+            )
+
         hbm = hbm_stats() if self.config.track_hbm else None
         record = build_step_event(
             ts=now,
@@ -408,6 +485,7 @@ class Telemetry:
             hbm_bytes_in_use=(hbm or {}).get("bytes_in_use"),
             hbm_peak_bytes=(hbm or {}).get("peak_bytes_in_use"),
             hbm_bytes_limit=(hbm or {}).get("bytes_limit"),
+            fleet=fleet_fields,
             **attr_fields,
         )
         snapshot = self.registry.snapshot()
@@ -419,6 +497,9 @@ class Telemetry:
         if self._closed:
             return
         self._closed = True
+        # stop receiving other runs' barrier waits: a closed pipeline's
+        # counters are a finished run's record, not a live subscriber
+        unregister_sync_registry(self.registry)
         if self.attribution is not None:
             try:
                 self.attribution.close()  # stop an in-flight auto-capture

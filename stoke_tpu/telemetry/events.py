@@ -101,10 +101,38 @@ STEP_EVENT_FIELDS: Dict[str, tuple] = {
     "goodput_loader_s": (False, "nullable_number"),
     "goodput_checkpoint_s": (False, "nullable_number"),
     "goodput_halt_s": (False, "nullable_number"),
+    # fleet view (ISSUE 5; keys absent without a FleetConfig, null between
+    # exchange windows): cross-host skew aggregates derived from the
+    # in-band per-host signal exchange — hosts/window identify the
+    # exchange, wall_median/max the fleet step-time spread, step/loader
+    # skew + lag the straggler's excess over the fleet median,
+    # straggler_host/zscore/skew_class the verdict ("loader" = input-
+    # pipeline-bound host, "compute" = slow step), and barrier fields the
+    # barrier-wait attribution (the max wait, charged to the LAST arrival
+    # — the host the fleet was waiting for, not the waiters)
+    "fleet/hosts": (False, "nullable_number"),
+    "fleet/window": (False, "nullable_number"),
+    "fleet/wall_median_s": (False, "nullable_number"),
+    "fleet/wall_max_s": (False, "nullable_number"),
+    "fleet/step_skew_s": (False, "nullable_number"),
+    "fleet/loader_skew_s": (False, "nullable_number"),
+    "fleet/lag_s": (False, "nullable_number"),
+    "fleet/lag_frac": (False, "nullable_number"),
+    "fleet/straggler_host": (False, "nullable_number"),
+    "fleet/straggler_zscore": (False, "nullable_number"),
+    "fleet/skew_class": (False, "nullable_string"),
+    "fleet/barrier_wait_s": (False, "nullable_number"),
+    "fleet/barrier_charged_host": (False, "nullable_number"),
     "hbm_bytes_in_use": (False, "nullable_number"),
     "hbm_peak_bytes": (False, "nullable_number"),
     "hbm_bytes_limit": (False, "nullable_number"),
 }
+
+#: the fleet-view subset of the schema (populated via ``build_step_event``'s
+#: ``fleet=`` dict; stoke_tpu.telemetry.fleet.FLEET_EVENT_FIELDS must match)
+FLEET_STEP_FIELDS = tuple(
+    f for f in STEP_EVENT_FIELDS if f.startswith("fleet/")
+)
 
 
 def _kind_ok(value: Any, kind: str) -> bool:
@@ -222,6 +250,7 @@ def build_step_event(
     hbm_bytes_in_use: Optional[int] = None,
     hbm_peak_bytes: Optional[int] = None,
     hbm_bytes_limit: Optional[int] = None,
+    fleet: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Assemble + validate a v1 step event (single construction point so the
     schema cannot drift from the writer)."""
@@ -285,5 +314,24 @@ def build_step_event(
         "hbm_peak_bytes": hbm_peak_bytes,
         "hbm_bytes_limit": hbm_bytes_limit,
     }
+    if fleet is not None:
+        # fleet view (ISSUE 5): keys appear only when a FleetMonitor is
+        # attached; the slash-named fields cannot be python kwargs, so
+        # they arrive as one dict — unknown keys fail validation below
+        for key in FLEET_STEP_FIELDS:
+            value = fleet.get(key)
+            if key == "fleet/skew_class":
+                record[key] = value
+            elif key in ("fleet/hosts", "fleet/window",
+                         "fleet/straggler_host",
+                         "fleet/barrier_charged_host"):
+                record[key] = None if value is None else int(value)
+            else:
+                record[key] = _round(value)
+        unknown = set(fleet) - set(FLEET_STEP_FIELDS)
+        if unknown:
+            raise ValueError(
+                f"unknown fleet step-event fields {sorted(unknown)}"
+            )
     validate_step_event(record)
     return record

@@ -88,6 +88,7 @@ class FlightRecorder:
         install_signal_handlers: bool = False,
         goodput_fn: Optional[Callable[[], Optional[Dict[str, Any]]]] = None,
         cost_cards_fn: Optional[Callable[[], Any]] = None,
+        fleet_fn: Optional[Callable[[], Optional[Dict[str, Any]]]] = None,
     ):
         self.bundle_dir = bundle_dir
         self._ring: "deque[dict]" = deque(maxlen=int(ring_size))
@@ -103,6 +104,9 @@ class FlightRecorder:
         # the last analyzed CostCards join every bundle when wired
         self._goodput_fn = goodput_fn
         self._cost_cards_fn = cost_cards_fn
+        # ISSUE 5: which host was slow at time of death — the latest
+        # per-host fleet matrix + straggler verdict join every bundle
+        self._fleet_fn = fleet_fn
         self.dumps: List[str] = []
         self._prev_handlers: Dict[int, Any] = {}
         if install_signal_handlers:
@@ -203,6 +207,13 @@ class FlightRecorder:
                 cards = self._cost_cards_fn()
                 if cards:
                     self._write_json(path, "cost_cards.json", cards)
+            except Exception:
+                pass
+        if self._fleet_fn is not None:
+            try:
+                fleet = self._fleet_fn()
+                if fleet is not None:
+                    self._write_json(path, "fleet.json", fleet)
             except Exception:
                 pass
         self._write_stacks(path)

@@ -92,6 +92,21 @@ class JsonlSink(Sink):
 # --------------------------------------------------------------------------- #
 
 
+def host_labels(process_index: int = 0) -> Dict[str, str]:
+    """Identity labels for multi-host expositions (ISSUE 5 satellite):
+    ``host`` (this machine's hostname) and ``process_index`` (the JAX
+    process rank).  Without them, per-host scrape files of the same job
+    aggregated into one Prometheus collide into a single series and the
+    per-host skew the fleet view exists to expose is unplottable."""
+    import socket
+
+    try:
+        host = socket.gethostname() or "unknown"
+    except OSError:  # pragma: no cover - exotic resolver failures
+        host = "unknown"
+    return {"host": host, "process_index": str(int(process_index))}
+
+
 def _prom_name(name: str) -> str:
     """Registry name -> Prometheus metric name: slashes become underscores,
     invalid chars collapse, and everything gets the ``stoke_`` namespace."""

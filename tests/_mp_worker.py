@@ -66,7 +66,7 @@ GLOBAL_BATCH = 32
 
 
 def make_stoke(fmt=CheckpointFormat.consolidated, fsdp=False, async_save=False,
-               save_rank=0):
+               save_rank=0, extra_configs=()):
     params = {
         "w": jnp.asarray(
             np.random.default_rng(7).normal(size=(IN, OUT)).astype(np.float32) * 0.1
@@ -83,6 +83,7 @@ def make_stoke(fmt=CheckpointFormat.consolidated, fsdp=False, async_save=False,
     ]
     if fsdp:
         cfgs.append(FSDPConfig(min_weight_size=1))
+    cfgs.extend(extra_configs)
     return Stoke(
         model=lambda p, x: x @ p["w"],
         optimizer=StokeOptimizer(
@@ -326,6 +327,93 @@ def main():
         )
         xs = jnp.asarray(r.normal(size=(4, 2, 4)).astype(np.float32))
         jax.grad(lambda p: jnp.sum(piped(p, xs) ** 2))(stages)
+
+    elif SCENARIO == "fleet":
+        # fleet observability (ISSUE 5 acceptance): 2 hosts, worker 1's
+        # loader sleeps per item -> its loader_wait skews high, worker 0
+        # waits at the per-step barrier for it.  Rank 0's JSONL must carry
+        # the per-host fleet/* fields with the straggler verdict pointing
+        # at host 1 (loader-classified), the barrier wait charged to host
+        # 1, and the health registry must record EXACTLY ONE
+        # fleet_straggler anomaly (K=5 streak can complete only once in
+        # the 7 windows the 8 steps close — the first record anchors).
+        import time
+
+        from stoke_tpu import FleetConfig, HealthConfig, TelemetryConfig
+        from stoke_tpu.data import BucketedDistributedSampler
+
+        N_ROWS, BATCH_STEPS, SLEEP_S = 256, 8, 0.02
+
+        class _SleepyRows:
+            """Per-item sleep models a slow input pipeline on ONE host."""
+
+            def __init__(self, sleep_s):
+                r = np.random.default_rng(3)
+                self.x = r.normal(size=(N_ROWS, IN)).astype(np.float32)
+                self.y = (
+                    self.x @ np.ones((IN, OUT), np.float32)
+                ).astype(np.float32)
+                self.sleep_s = sleep_s
+
+            def __len__(self):
+                return N_ROWS
+
+            def __getitem__(self, i):
+                if self.sleep_s:
+                    time.sleep(self.sleep_s)
+                return self.x[i], self.y[i]
+
+        out_dir = os.path.join(TMP, "telemetry")
+        s = make_stoke(extra_configs=[
+            TelemetryConfig(
+                output_dir=out_dir,
+                log_every_n_steps=1,
+                jsonl_all_ranks=True,
+                prometheus=True,
+                prometheus_all_ranks=True,
+                sample_device_time=False,
+            ),
+            FleetConfig(
+                window_steps=1,
+                straggler_rel_frac=0.1,
+                # K=5 of 8 windows: exactly ONE streak can complete (at
+                # window 5, surfacing at step 6's health observation);
+                # the second streak is only 3 windows deep at the end
+                straggler_windows=5,
+                straggler_action="warn",
+            ),
+            HealthConfig(dump_signals=False, detector_warmup_steps=1000),
+        ])
+        data = _SleepyRows(SLEEP_S if PID == 1 else 0.0)
+        sampler = BucketedDistributedSampler(
+            data, buckets=1, batch_size=16,
+            sorted_idx=list(range(N_ROWS)),
+            num_replicas=NPROC, rank=PID, info_rank=0,
+        )
+        loader = s.DataLoader(data, sampler=sampler)
+        steps = 0
+        import warnings as _warnings
+
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore")
+            for x, y in loader:
+                s.train_step(x, (y,))
+                s.barrier()  # per-step host coordination, the wait source
+                steps += 1
+                if steps >= BATCH_STEPS:
+                    break
+        assert steps == BATCH_STEPS, steps
+        s.close_telemetry()  # drains any final-window straggler streak
+        summary = s.fleet_summary
+        by_detector = s.health.anomaly_counts_by_detector()
+        with open(os.path.join(TMP, f"fleet_result_p{PID}.json"), "w") as f:
+            json.dump({
+                "anomalies_by_detector": by_detector,
+                "windows": summary["windows"],
+                "n_processes": summary["n_processes"],
+                "last_verdict": summary["last_verdict"],
+                "straggler_events": summary["straggler_events"],
+            }, f, default=repr)
 
     elif SCENARIO == "loader":
         # multi-process DataLoader REQUIRES a distributed sampler

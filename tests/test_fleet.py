@@ -1,0 +1,830 @@
+"""Fleet observability tests (ISSUE 5): packed-vector layout, skew /
+z-score / argmax-host math, barrier-wait attribution, status rules,
+default-OFF program identity, single-process fleet fields on the 8-device
+mesh, the straggler streak detector, and the offline rank-JSONL merge.
+
+All CPU-only and deterministic on the 8-device simulated mesh (conftest);
+the real cross-process exchange is covered by
+tests/test_multiprocess.py::test_fleet_multiprocess.
+"""
+
+import json
+import os
+import warnings
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from stoke_tpu import (
+    FleetConfig,
+    HealthConfig,
+    Stoke,
+    StokeOptimizer,
+    StokeStatus,
+    StokeValidationError,
+    TelemetryConfig,
+)
+from stoke_tpu.telemetry import read_step_events
+from stoke_tpu.telemetry.fleet import (
+    FLEET_EVENT_FIELDS,
+    FLEET_INDEX,
+    FLEET_SIGNALS,
+    N_FLEET_SIGNALS,
+    FleetMonitor,
+    FleetStragglerDetector,
+    fleet_aggregates,
+    observe_sync_wait,
+    pack_fleet_vector,
+    register_sync_registry,
+    straggler_verdict,
+    timed_sync,
+    unpack_fleet_vector,
+)
+from stoke_tpu.telemetry.registry import MetricsRegistry
+
+pytestmark = pytest.mark.fleet
+
+IN, OUT = 8, 4
+
+
+def _make_stoke(tmp_path, *, fleet=True, tag="run", fleet_over=None,
+                configs_extra=(), log_every=1):
+    configs = [TelemetryConfig(
+        output_dir=str(tmp_path / tag / "telemetry"),
+        log_every_n_steps=log_every,
+        sample_device_time=False,
+        prometheus=False,
+    )]
+    if fleet:
+        configs.append(FleetConfig(**{"window_steps": 1,
+                                      **(fleet_over or {})}))
+    configs.extend(configs_extra)
+    return Stoke(
+        model=lambda p, x: x @ p["w"],
+        optimizer=StokeOptimizer(
+            optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.05}
+        ),
+        loss=lambda o, y: ((o - y) ** 2).mean(),
+        params={"w": np.ones((IN, OUT), np.float32) * 0.1},
+        batch_size_per_device=4,
+        distributed="dp",
+        configs=configs,
+        verbose=False,
+    )
+
+
+def _batches(n, rng, batch=32):
+    W = rng.normal(size=(IN, OUT)).astype(np.float32)
+    out = []
+    for _ in range(n):
+        x = rng.normal(size=(batch, IN)).astype(np.float32)
+        out.append((x, (x @ W).astype(np.float32)))
+    return out
+
+
+def _matrix(rows):
+    """[{signal: value}] -> the [n_hosts, N] matrix."""
+    return np.stack([pack_fleet_vector(r) for r in rows])
+
+
+# --------------------------------------------------------------------------- #
+# packed-vector layout
+# --------------------------------------------------------------------------- #
+
+
+def test_pack_unpack_roundtrip():
+    signals = {
+        "step": 42.0, "wall_s": 1.5, "dispatches": 7.0,
+        "loader_wait_s": 0.25, "starvation_s": 0.1, "compile_s": 2.0,
+        "barrier_wait_s": 0.3, "goodput_productive_s": 1.0,
+        "goodput_compile_s": 0.2, "goodput_recompile_s": 0.0,
+        "goodput_loader_s": 0.1, "goodput_checkpoint_s": 0.0,
+        "goodput_halt_s": 0.0, "health_anomalies": 1.0,
+        "comm_bytes_onwire": 1e6,
+    }
+    vec = pack_fleet_vector(signals)
+    assert vec.shape == (N_FLEET_SIGNALS,) and vec.dtype == np.float32
+    back = unpack_fleet_vector(vec)
+    for name, value in signals.items():
+        assert back[name] == pytest.approx(value, rel=1e-6)
+    # partial packs fill zeros; unknown keys fail loud
+    sparse = unpack_fleet_vector(pack_fleet_vector({"wall_s": 2.0}))
+    assert sparse["wall_s"] == 2.0 and sparse["loader_wait_s"] == 0.0
+    with pytest.raises(ValueError, match="unknown fleet signals"):
+        pack_fleet_vector({"walls_s": 1.0})
+    # a vector from a different code version (wrong length) fails loud
+    with pytest.raises(ValueError, match="mixed code versions"):
+        unpack_fleet_vector(np.zeros(N_FLEET_SIGNALS + 1, np.float32))
+
+
+def test_layout_matches_schema_and_goodput_buckets():
+    # the packed layout's goodput slice must mirror the attribution
+    # ledger's buckets, and the JSONL field list must match the schema's
+    # fleet/* subset — drift here silently corrupts the wire format
+    from stoke_tpu.telemetry.attribution import GOODPUT_BUCKETS
+    from stoke_tpu.telemetry.events import FLEET_STEP_FIELDS
+
+    assert tuple(f"goodput_{b}_s" for b in GOODPUT_BUCKETS) == tuple(
+        s for s in FLEET_SIGNALS if s.startswith("goodput_")
+    )
+    assert set(FLEET_EVENT_FIELDS) == set(FLEET_STEP_FIELDS)
+
+
+# --------------------------------------------------------------------------- #
+# aggregation / skew / straggler math (synthetic matrices)
+# --------------------------------------------------------------------------- #
+
+
+def test_fleet_aggregates_min_median_max_p99_argmax():
+    rows = [
+        {"step": 10, "wall_s": w, "loader_wait_s": l}
+        for w, l in ((1.0, 0.0), (1.1, 0.2), (1.2, 0.1), (5.0, 0.05))
+    ]
+    agg = fleet_aggregates(_matrix(rows))
+    assert agg["wall_s"]["min"] == pytest.approx(1.0)
+    assert agg["wall_s"]["max"] == pytest.approx(5.0)
+    assert agg["wall_s"]["median"] == pytest.approx(1.15, rel=1e-6)
+    assert agg["wall_s"]["argmax_host"] == 3
+    assert agg["loader_wait_s"]["argmax_host"] == 1
+    assert 1.2 < agg["wall_s"]["p99"] <= 5.0
+    with pytest.raises(ValueError, match="fleet matrix"):
+        fleet_aggregates(np.zeros((2, 3)))
+
+
+def test_straggler_argmax_host_and_zscore():
+    # 4 hosts, one clearly slow in step time: flagged via BOTH the
+    # relative and the z-score path, classified compute-skew
+    rows = [{"step": 1, "wall_s": 1.0} for _ in range(4)]
+    rows[2]["wall_s"] = 3.0
+    v = straggler_verdict(_matrix(rows), rel_threshold=0.5,
+                          zscore_threshold=1.1)
+    assert v["flagged"] and v["host"] == 2
+    assert v["step_skew_s"] == pytest.approx(2.0)
+    assert v["lag_s"] == pytest.approx(2.0)
+    assert v["lag_frac"] == pytest.approx(2.0)
+    assert v["zscore"] is not None and v["zscore"] > 1.1
+    assert v["skew_class"] == "compute"
+    assert v["wall_median_s"] == pytest.approx(1.0)
+    assert v["wall_max_s"] == pytest.approx(3.0)
+
+
+def test_straggler_zscore_fires_on_small_fleets():
+    # regression: an ALL-host z-score is bounded by sqrt(n-1), so the
+    # default 3-sigma threshold could never fire on fleets of < 10 hosts.
+    # The leave-one-out z (host vs the rest) must clear 3 sigma on a
+    # 4-host pod with one 20%-slow host even when the relative threshold
+    # is out of reach.
+    rows = [
+        {"step": 1, "wall_s": w}
+        for w in (1.0, 1.01, 0.99, 1.2)
+    ]
+    v = straggler_verdict(_matrix(rows), rel_threshold=0.5,
+                          zscore_threshold=3.0)
+    assert v["host"] == 3
+    assert v["lag_frac"] < 0.5  # rel path alone would NOT flag
+    assert v["zscore"] > 3.0
+    assert v["flagged"]
+    # ... but microscopic skew below the noise floor never z-flags, even
+    # when the rest of the fleet is perfectly tight
+    tight = [{"step": 1, "wall_s": 1.0} for _ in range(4)]
+    tight[1]["wall_s"] = 1.001
+    v2 = straggler_verdict(_matrix(tight), rel_threshold=0.5,
+                           zscore_threshold=3.0)
+    assert not v2["flagged"]
+
+
+def test_straggler_loader_classification():
+    # the slow host's lag comes from its input pipeline, not its step
+    rows = [
+        {"step": 1, "wall_s": 1.0, "loader_wait_s": 0.05}
+        for _ in range(4)
+    ]
+    rows[1]["loader_wait_s"] = 0.9
+    v = straggler_verdict(_matrix(rows), rel_threshold=0.5,
+                          zscore_threshold=3.0)
+    assert v["flagged"] and v["host"] == 1
+    assert v["skew_class"] == "loader"
+    assert v["loader_skew_s"] == pytest.approx(0.85)
+
+
+def test_straggler_two_host_fleet_uses_relative_threshold():
+    # with 2 hosts the z path is structurally off (a 1-sample "rest of
+    # the fleet" has no spread); the relative threshold is the signal
+    rows = [
+        {"step": 1, "wall_s": 1.0, "loader_wait_s": 0.0},
+        {"step": 1, "wall_s": 1.0, "loader_wait_s": 0.8},
+    ]
+    v = straggler_verdict(_matrix(rows), rel_threshold=0.3,
+                          zscore_threshold=3.0)
+    assert v["flagged"] and v["host"] == 1
+    # a 1-sample "rest of the fleet" has no spread: the z-score is None
+    # (not a meaningless huge number) and can never flag on its own
+    assert v["zscore"] is None
+    v_hi = straggler_verdict(_matrix(rows), rel_threshold=10.0,
+                             zscore_threshold=3.0)
+    assert not v_hi["flagged"]
+    # and a tight fleet does NOT flag
+    rows[1]["loader_wait_s"] = 0.01
+    v2 = straggler_verdict(_matrix(rows), rel_threshold=0.3,
+                           zscore_threshold=3.0)
+    assert not v2["flagged"]
+
+
+def test_barrier_wait_charged_to_last_arrival():
+    # hosts 0/2 waited at the barrier; host 1 arrived last (zero wait):
+    # the fleet's barrier cost (max wait) is charged to host 1
+    rows = [
+        {"step": 1, "wall_s": 1.0, "barrier_wait_s": 0.5},
+        {"step": 1, "wall_s": 1.0, "barrier_wait_s": 0.0},
+        {"step": 1, "wall_s": 1.0, "barrier_wait_s": 0.45},
+    ]
+    v = straggler_verdict(_matrix(rows), rel_threshold=0.3,
+                          zscore_threshold=3.0)
+    assert v["barrier_wait_s"] == pytest.approx(0.5)
+    assert v["barrier_charged_host"] == 1
+    # barrier lateness feeds the lag, so the late host IS the straggler
+    assert v["flagged"] and v["host"] == 1
+    # no barriers this window -> nothing to charge
+    for r in rows:
+        r["barrier_wait_s"] = 0.0
+    v2 = straggler_verdict(_matrix(rows), rel_threshold=0.3,
+                           zscore_threshold=3.0)
+    assert v2["barrier_charged_host"] is None
+    # EQUAL waits (the sync's own round-trip cost) -> nobody was late;
+    # charging argmin would blame host 0 for doing nothing wrong
+    for r in rows:
+        r["barrier_wait_s"] = 0.4
+    v3 = straggler_verdict(_matrix(rows), rel_threshold=0.3,
+                           zscore_threshold=3.0)
+    assert v3["barrier_wait_s"] == pytest.approx(0.4)
+    assert v3["barrier_charged_host"] is None
+
+
+def test_uniform_fleet_is_quiet():
+    rows = [{"step": 1, "wall_s": 1.0, "loader_wait_s": 0.1}] * 4
+    v = straggler_verdict(_matrix(rows), rel_threshold=0.1,
+                          zscore_threshold=3.0)
+    assert not v["flagged"]
+    assert v["skew_class"] == "none"
+    # a fleet of one can never straggle against itself
+    v1 = straggler_verdict(_matrix(rows[:1]), rel_threshold=0.01,
+                           zscore_threshold=0.1)
+    assert not v1["flagged"] and v1["skew_class"] == "none"
+
+
+# --------------------------------------------------------------------------- #
+# status rules
+# --------------------------------------------------------------------------- #
+
+
+def _status(configs, **kw):
+    return StokeStatus(batch_size_per_device=4, configs=configs, **kw)
+
+
+def test_status_requires_telemetry():
+    with pytest.raises(StokeValidationError,
+                       match="requires a TelemetryConfig"):
+        _status([FleetConfig()])
+
+
+def test_status_validates_thresholds(tmp_path):
+    tcfg = TelemetryConfig(output_dir=str(tmp_path / "t"), prometheus=False)
+    with pytest.raises(StokeValidationError, match="window_steps"):
+        _status([tcfg, FleetConfig(window_steps=0)])
+    with pytest.raises(StokeValidationError, match="straggler_zscore"):
+        _status([tcfg, FleetConfig(straggler_zscore=0.0)])
+    with pytest.raises(StokeValidationError, match="straggler_rel_frac"):
+        _status([tcfg, FleetConfig(straggler_rel_frac=-0.5)])
+    with pytest.raises(StokeValidationError, match="straggler_windows"):
+        _status([tcfg, FleetConfig(straggler_windows=0)])
+    with pytest.raises(StokeValidationError, match="straggler_action"):
+        _status([tcfg, FleetConfig(straggler_action="explode")])
+    # halt is a health action but NOT a straggler action: a slow host is
+    # a diagnosis, never a reason to kill the run
+    with pytest.raises(StokeValidationError, match="halt"):
+        _status([tcfg, FleetConfig(straggler_action="halt")])
+    # valid combination passes
+    _status([tcfg, FleetConfig()])
+
+
+def test_fleet_config_yaml_buildable(tmp_path):
+    from stoke_tpu.utils import stoke_kwargs_from_config
+
+    kwargs = stoke_kwargs_from_config({
+        "batch_size_per_device": 4,
+        "configs": {
+            "TelemetryConfig": {
+                "output_dir": str(tmp_path / "t"), "prometheus": False,
+            },
+            "FleetConfig": {
+                "window_steps": 5, "straggler_zscore": 2.5,
+                "straggler_action": "dump",
+            },
+        },
+    })
+    by_type = {type(c).__name__: c for c in kwargs["configs"]}
+    assert by_type["FleetConfig"].window_steps == 5
+    assert by_type["FleetConfig"].straggler_zscore == 2.5
+    assert by_type["FleetConfig"].straggler_action == "dump"
+
+
+# --------------------------------------------------------------------------- #
+# default-OFF identity (acceptance: bit-identical step programs)
+# --------------------------------------------------------------------------- #
+
+
+def test_fleet_off_is_bit_identical_and_on_adds_no_dispatches(
+    tmp_path, devices
+):
+    """The fleet view is host-side bookkeeping plus (multi-process only)
+    one out-of-band allgather: the engine dispatch count AND the lowered
+    step-program HLO are identical with the config absent vs present
+    (same technique as the PR 3/4 acceptance)."""
+    rng_a = np.random.default_rng(5)
+    rng_b = np.random.default_rng(5)
+    s_off = _make_stoke(tmp_path, fleet=False, tag="off")
+    s_on = _make_stoke(tmp_path, fleet=True, tag="on")
+    batches_a = _batches(4, rng_a)
+    batches_b = _batches(4, rng_b)
+    for s, batches in ((s_off, batches_a), (s_on, batches_b)):
+        for x, y in batches[:2]:
+            s.train_step(x, (y,))
+        for x, y in batches[2:]:
+            out = s.model(x)
+            loss = s.loss(out, y)
+            s.backward(loss)
+            s.step()
+        s.close_telemetry()
+    assert s_on.dispatch_count == s_off.dispatch_count
+    assert s_on.optimizer_steps == s_off.optimizer_steps == 4
+    np.testing.assert_array_equal(
+        np.asarray(s_on.params["w"]), np.asarray(s_off.params["w"])
+    )
+    x, y = batches_a[0]
+
+    def fused_hlo(s):
+        from stoke_tpu.engine import DeferredOutput, is_deferred
+
+        margs = s._place_batch((x,))
+        sentinel = DeferredOutput(None, -1)
+        flat, treedef = jax.tree_util.tree_flatten(
+            ((sentinel, y), {}), is_leaf=is_deferred
+        )
+        arrays = s._place_batch([l for l in flat if not is_deferred(l)])
+        deferred = tuple(
+            (i, l._path) for i, l in enumerate(flat) if is_deferred(l)
+        )
+        fn = s._engine._build_fused(treedef, deferred, True)
+        return fn.lower(
+            s._variables, s._opt_state, s._grad_buf, s._scaler_state,
+            s._comm_state, s._rng, margs, {}, arrays,
+        ).as_text()
+
+    assert fused_hlo(s_on) == fused_hlo(s_off)
+
+
+# --------------------------------------------------------------------------- #
+# single-process fleet view (the 8-device mesh; a fleet of one host)
+# --------------------------------------------------------------------------- #
+
+
+def test_single_process_fleet_fields_in_jsonl(tmp_path, devices):
+    s = _make_stoke(tmp_path, tag="solo")
+    for x, y in _batches(3, np.random.default_rng(0)):
+        s.train_step(x, (y,))
+    s.close_telemetry()
+    records = read_step_events(
+        str(tmp_path / "solo" / "telemetry" / "steps.jsonl")
+    )
+    assert len(records) == 3
+    # the first record anchors the cadence (warm-up discard): keys
+    # present, values null
+    assert records[0]["fleet/hosts"] is None
+    assert "fleet/window" in records[0]
+    for i, rec in enumerate(records[1:]):
+        assert rec["fleet/hosts"] == 1
+        assert rec["fleet/window"] == i + 1
+        assert rec["fleet/skew_class"] == "none"
+        assert rec["fleet/step_skew_s"] == 0.0
+        assert rec["fleet/straggler_host"] is None
+        assert rec["fleet/wall_median_s"] == rec["fleet/wall_max_s"]
+    # aggregate gauges + counters landed in the registry
+    reg = s.telemetry.registry
+    assert reg.counter("fleet/windows_total").value == 2
+    assert reg.counter("fleet/anomalies_total").value == 0
+    assert reg.get("fleet/wall_s_median") is not None
+    assert reg.get("fleet/wall_s_argmax_host") is not None
+    # end-of-run summary carries the per-host matrix
+    summary = s.fleet_summary
+    assert summary["windows"] == 2 and summary["n_processes"] == 1
+    assert set(summary["last_matrix"]) == {"0"}
+    assert summary["last_verdict"]["skew_class"] == "none"
+    assert summary["straggler_anomalies"] == 0
+
+
+def test_fleet_fields_absent_without_config(tmp_path, devices):
+    s = _make_stoke(tmp_path, fleet=False, tag="nofleet")
+    for x, y in _batches(2, np.random.default_rng(0)):
+        s.train_step(x, (y,))
+    s.close_telemetry()
+    records = read_step_events(
+        str(tmp_path / "nofleet" / "telemetry" / "steps.jsonl")
+    )
+    assert all("fleet/hosts" not in r for r in records)
+    assert s.fleet is None and s.fleet_summary is None
+
+
+def test_window_cadence(tmp_path, devices):
+    # window_steps=2 at log cadence 1: records alternate null / populated
+    s = _make_stoke(tmp_path, tag="cadence",
+                    fleet_over={"window_steps": 2})
+    for x, y in _batches(5, np.random.default_rng(1)):
+        s.train_step(x, (y,))
+    s.close_telemetry()
+    records = read_step_events(
+        str(tmp_path / "cadence" / "telemetry" / "steps.jsonl")
+    )
+    populated = [r["step"] for r in records if r["fleet/hosts"] is not None]
+    assert populated == [2, 4]
+    # null-window records still carry the keys (stable shape)
+    assert all("fleet/hosts" in r for r in records)
+    assert s.fleet.windows == 2
+
+
+def test_window_cadence_long_window():
+    # window_steps much larger than the record cadence: the warm-up
+    # partial must NOT close early (regression: the first-window anchor
+    # was bypassed while windows == 0, firing the cross-host exchange at
+    # step 2 of a window_steps=10 run)
+    reg = MetricsRegistry()
+    mon = FleetMonitor(FleetConfig(window_steps=10), reg,
+                       rank=0, n_processes=1)
+    closed, walls = [], []
+    for step in range(1, 31):
+        fields = mon.window_stats(step=step, wall_s=0.1)
+        if fields["fleet/hosts"] is not None:
+            closed.append(step)
+            walls.append(float(mon.last_matrix[0, FLEET_INDEX["wall_s"]]))
+    assert closed == [10, 20, 30]
+    assert mon.windows == 3
+    # the anchor record's warm-up accumulation (init->first-record wall,
+    # compile skew) is DISCARDED — the first window covers records 2..10,
+    # later windows their full 10-record span
+    assert walls == pytest.approx([0.9, 1.0, 1.0], rel=1e-5)
+
+
+# --------------------------------------------------------------------------- #
+# straggler streak detector (synthetic exchange)
+# --------------------------------------------------------------------------- #
+
+
+def _driven_monitor(straggler_windows=2, action="warn", hosts=4,
+                    straggle_host=2):
+    """A FleetMonitor whose exchange is replaced by a synthetic 4-host
+    matrix with one slow host — the single-process stand-in for a pod."""
+    reg = MetricsRegistry()
+    cfg = FleetConfig(
+        window_steps=1, straggler_rel_frac=0.5,
+        straggler_windows=straggler_windows, straggler_action=action,
+    )
+    mon = FleetMonitor(cfg, reg, rank=0, n_processes=1)
+
+    def fake_exchange(vec):
+        rows = [dict(unpack_fleet_vector(vec)) for _ in range(hosts)]
+        for r in rows:
+            r["wall_s"] = 1.0
+        rows[straggle_host]["wall_s"] = 3.0
+        return _matrix(rows).astype(np.float32)
+
+    mon._exchange = fake_exchange
+    return mon, reg
+
+
+def test_straggler_streak_fires_once_then_rearms():
+    mon, reg = _driven_monitor(straggler_windows=2, action="record")
+    # first record anchors the cadence (warm-up discard): nulls, no fire
+    assert mon.window_stats(step=1, wall_s=1.0)["fleet/hosts"] is None
+    fields2 = mon.window_stats(step=2, wall_s=1.0)
+    assert fields2["fleet/straggler_host"] == 2
+    assert mon.consume_straggler() is None  # streak of 1 < K=2
+    mon.window_stats(step=3, wall_s=1.0)
+    event = mon.consume_straggler()  # streak reached K
+    assert event is not None and event["host"] == 2
+    assert event["skew_class"] == "compute"
+    assert reg.counter("fleet/anomalies_total").value == 1
+    assert reg.counter("fleet/straggler_windows_total").value == 2
+    # re-armed: the NEXT firing needs a fresh K-window streak
+    mon.window_stats(step=4, wall_s=1.0)
+    assert mon.consume_straggler() is None
+    mon.window_stats(step=5, wall_s=1.0)
+    assert mon.consume_straggler() is not None
+    assert reg.counter("fleet/anomalies_total").value == 2
+
+
+def test_straggler_warn_fallback_without_health():
+    mon, _ = _driven_monitor(straggler_windows=1, action="warn")
+    mon.window_stats(step=1, wall_s=1.0)  # anchor
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        mon.window_stats(step=2, wall_s=1.0)
+    msgs = [str(w.message) for w in caught]
+    assert any("straggled" in m and "host 2" in m for m in msgs)
+
+
+def test_straggler_detector_adapts_to_health_registry():
+    mon, _ = _driven_monitor(straggler_windows=1, action="warn")
+    det = FleetStragglerDetector(mon, "warn")
+    assert det.name == "fleet_straggler"
+    assert det.check(1, None, None) is None  # nothing pending yet
+    mon.window_stats(step=1, wall_s=1.0)  # anchor
+    mon.window_stats(step=2, wall_s=1.0)
+    anomaly = det.check(1, None, None)
+    assert anomaly is not None
+    assert anomaly.detector == "fleet_straggler"
+    assert anomaly.action == "warn"
+    assert "host 2" in anomaly.message
+    # consumed: a second observation does not re-fire
+    assert det.check(2, None, None) is None
+
+
+def test_fleet_straggler_lands_in_health_pipeline(tmp_path, devices):
+    """End-to-end on one process: a synthetic straggler exchange must
+    surface as EXACTLY ONE fleet_straggler anomaly in the health
+    registry and its post-mortem bundle must carry fleet.json."""
+    s = _make_stoke(
+        tmp_path, tag="health",
+        fleet_over={"straggler_windows": 2, "straggler_rel_frac": 0.5,
+                    "straggler_action": "warn"},
+        configs_extra=(HealthConfig(dump_signals=False,
+                                    detector_warmup_steps=100),),
+    )
+
+    real_exchange = s.fleet._exchange
+
+    def fake_exchange(vec):
+        rows = [dict(unpack_fleet_vector(real_exchange(vec)[0]))
+                for _ in range(2)]
+        rows[1]["wall_s"] = rows[0]["wall_s"] + 10.0
+        return _matrix(rows).astype(np.float32)
+
+    s.fleet._exchange = fake_exchange
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for x, y in _batches(5, np.random.default_rng(2)):
+            s.train_step(x, (y,))
+    # record 1 anchors; windows close at steps 2..5.  The fleet window
+    # closes AFTER the step's health observation, so the K=2 streak
+    # completed at window 2 (step 3) surfaces at step 4's observation;
+    # the second streak completes at window 4 (step 5) with no later
+    # step — it is drained at close_telemetry() below, not lost
+    assert s.health.anomaly_counts_by_detector() == {"fleet_straggler": 1}
+    bundle = s.health.dump("test")
+    with open(os.path.join(bundle, "fleet.json")) as f:
+        payload = json.load(f)
+    assert payload["last_verdict"]["flagged"]
+    assert payload["last_verdict"]["host"] == 1
+    assert set(payload["last_matrix"]) == {"0", "1"}
+    # both completed streaks are in the monitor's event log
+    assert len(payload["straggler_events"]) == 2
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s.close_telemetry()
+    assert s.health.anomaly_counts_by_detector() == {"fleet_straggler": 2}
+    s.close_telemetry()  # idempotent: the drain fires at most once
+    assert s.health.anomaly_counts_by_detector() == {"fleet_straggler": 2}
+
+
+# --------------------------------------------------------------------------- #
+# barrier-wait timing (always-on satellite)
+# --------------------------------------------------------------------------- #
+
+
+def test_timed_sync_feeds_registered_registries():
+    reg = MetricsRegistry()
+    register_sync_registry(reg)
+    # pre-registered zeros (scrapes before the first barrier)
+    assert reg.counter("sync/barrier_wait_s").value == 0.0
+    with timed_sync("test"):
+        pass
+    assert reg.counter("sync/barriers_total").value == 1
+    # per-source attribution: the tag gets its own counter next to the
+    # aggregate (is it checkpoint coordination or explicit barriers?)
+    assert reg.get("sync/test_wait_s") is not None
+    observe_sync_wait(0.5, tag="ckpt")
+    assert reg.counter("sync/barrier_wait_s").value >= 0.5
+    assert reg.counter("sync/ckpt_wait_s").value == pytest.approx(0.5)
+    assert reg.counter("sync/barriers_total").value == 2
+
+
+def test_stoke_registry_receives_sync_counters(tmp_path, devices):
+    # every Stoke registers its telemetry registry for sync timings even
+    # WITHOUT a FleetConfig — cross-process sync time must be visible to
+    # the plain telemetry stack (the ISSUE 5 satellite contract)
+    s = _make_stoke(tmp_path, fleet=False, tag="sync")
+    assert s.telemetry.registry.get("sync/barrier_wait_s") is not None
+    # zero accrued -> the wall-clock breakdown stays sync-free
+    assert not any(
+        k.startswith("sync/")
+        for k in s.wall_clock_breakdown
+        if s.telemetry.registry.counter("sync/barrier_wait_s").value == 0
+    )
+    before = s.telemetry.registry.counter("sync/barriers_total").value
+    observe_sync_wait(0.01)
+    assert (
+        s.telemetry.registry.counter("sync/barriers_total").value
+        == before + 1
+    )
+    # accrued sync time surfaces in the wall-clock breakdown (the
+    # "visible even without FleetConfig" satellite contract)
+    assert s.wall_clock_breakdown["sync/barrier_wait"] >= 0.01
+    # a CLOSED run stops subscribing: later runs' barrier waits must not
+    # corrupt its post-run summary
+    s.close_telemetry()
+    frozen = s.telemetry.registry.counter("sync/barriers_total").value
+    observe_sync_wait(0.01)
+    assert (
+        s.telemetry.registry.counter("sync/barriers_total").value == frozen
+    )
+
+
+def test_barrier_wait_accumulates_into_fleet_vector():
+    reg = MetricsRegistry()
+    register_sync_registry(reg)
+    cfg = FleetConfig(window_steps=1)
+    mon = FleetMonitor(cfg, reg, rank=0, n_processes=1)
+    mon.window_stats(step=1, wall_s=1.0)  # anchor (warm-up discard)
+    observe_sync_wait(0.25)
+    fields = mon.window_stats(step=2, wall_s=1.0)
+    assert fields["fleet/barrier_wait_s"] == pytest.approx(0.25, abs=1e-6)
+    # counter deltas: a later window without barriers reports zero
+    fields = mon.window_stats(step=3, wall_s=1.0)
+    assert fields["fleet/barrier_wait_s"] == 0.0
+
+
+# --------------------------------------------------------------------------- #
+# Prometheus host label (satellite regression test)
+# --------------------------------------------------------------------------- #
+
+
+def test_prometheus_exposition_carries_host_labels():
+    from stoke_tpu.telemetry.sinks import host_labels, render_prometheus
+
+    labels = host_labels(3)
+    assert set(labels) == {"host", "process_index"}
+    assert labels["process_index"] == "3"
+    assert labels["host"]
+    reg = MetricsRegistry()
+    reg.counter("fleet/windows_total").inc(2)
+    reg.gauge("fleet/wall_s_max").set(1.5)
+    text = render_prometheus(reg.snapshot(), {"rank": "3", **labels})
+    # format regression: every sample line carries the full label set,
+    # counters keep the _total family suffix, TYPE headers stay unlabeled
+    assert "# TYPE stoke_fleet_windows_total counter" in text
+    esc_host = labels["host"].replace("\\", "\\\\").replace('"', '\\"')
+    sample = (
+        f'stoke_fleet_windows_total{{host="{esc_host}",'
+        f'process_index="3",rank="3"}} 2.0'
+    )
+    assert sample in text
+    assert (
+        f'stoke_fleet_wall_s_max{{host="{esc_host}",'
+        f'process_index="3",rank="3"}} 1.5'
+    ) in text
+
+
+def test_stoke_prometheus_file_has_host_label(tmp_path, devices):
+    configs = [TelemetryConfig(
+        output_dir=str(tmp_path / "prom" / "telemetry"),
+        log_every_n_steps=1, sample_device_time=False, prometheus=True,
+    ), FleetConfig(window_steps=1)]
+    s = Stoke(
+        model=lambda p, x: x @ p["w"],
+        optimizer=StokeOptimizer(
+            optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.05}
+        ),
+        loss=lambda o, y: ((o - y) ** 2).mean(),
+        params={"w": np.ones((IN, OUT), np.float32) * 0.1},
+        batch_size_per_device=4,
+        distributed="dp",
+        configs=configs,
+        verbose=False,
+    )
+    x, y = _batches(1, np.random.default_rng(0))[0]
+    s.train_step(x, (y,))
+    s.close_telemetry()
+    prom = open(
+        str(tmp_path / "prom" / "telemetry" / "metrics.prom")
+    ).read()
+    assert 'host="' in prom and 'process_index="0"' in prom
+    assert "stoke_fleet_windows_total" in prom
+    assert "stoke_sync_barriers_total" in prom
+
+
+def test_prometheus_all_ranks_writes_per_rank_file(tmp_path):
+    # prometheus_all_ranks: every process owns metrics.rank<N>.prom so
+    # each host's node exporter scrapes its LOCAL exposition (here one
+    # process, so exactly rank 0's file — the multi-process half lives
+    # in test_multiprocess.py::test_fleet_multiprocess)
+    from stoke_tpu.telemetry import Telemetry
+
+    cfg = TelemetryConfig(
+        output_dir=str(tmp_path / "t"), log_every_n_steps=1,
+        prometheus=True, prometheus_all_ranks=True, jsonl=False,
+        tensorboard=False, track_hbm=False, track_compiles=False,
+    )
+    t = Telemetry(cfg, rank=0)
+    t.registry.counter("fleet/windows_total").inc()
+    t.record_step(step=1)
+    t.close()
+    assert not os.path.exists(str(tmp_path / "t" / "metrics.prom"))
+    prom = open(str(tmp_path / "t" / "metrics.rank0.prom")).read()
+    assert 'process_index="0"' in prom
+    # non-zero ranks write their own file instead of staying silent
+    t1 = Telemetry(cfg, rank=1)
+    t1.registry.counter("fleet/windows_total").inc()
+    t1.record_step(step=1)
+    t1.close()
+    prom1 = open(str(tmp_path / "t" / "metrics.rank1.prom")).read()
+    assert 'process_index="1"' in prom1 and 'rank="1"' in prom1
+
+
+# --------------------------------------------------------------------------- #
+# offline twin: scripts/merge_rank_jsonl.py
+# --------------------------------------------------------------------------- #
+
+
+def _write_rank_stream(path, rank, walls, loader_waits):
+    from stoke_tpu.telemetry.events import build_step_event
+
+    ts = 1000.0
+    with open(path, "w") as f:
+        for step, (wall, lw) in enumerate(zip(walls, loader_waits), 1):
+            ts += wall
+            rec = build_step_event(
+                ts=ts, step=step, rank=rank, window_steps=1,
+                host_dispatch_s=0.01, loader_wait_s=lw,
+                samples_total=float(step * 32), compiles_total=1,
+                recompiles=0, compile_time_s=0.5,
+            )
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_merge_rank_jsonl_skew_table(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "merge_rank_jsonl",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "merge_rank_jsonl.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    d = tmp_path / "t"
+    d.mkdir()
+    # host 1 is consistently ~2x slower with the excess in loader wait
+    _write_rank_stream(str(d / "steps.rank0.jsonl"), 0,
+                       walls=[1.0] * 5, loader_waits=[0.0] * 5)
+    _write_rank_stream(str(d / "steps.rank1.jsonl"), 1,
+                       walls=[2.0] * 5, loader_waits=[1.0] * 5)
+    streams = {
+        rank: mod.load_stream(path, validate=True)
+        for rank, path in mod.discover_streams([str(d)])
+    }
+    assert set(streams) == {0, 1}
+    report = mod.merge(streams, rel_threshold=0.25, zscore=3.0)
+    assert report["hosts"] == [0, 1]
+    assert report["aligned_windows"] == 4  # first record has no baseline
+    assert report["flagged_windows"] == 4
+    assert report["modal_straggler"] == 1
+    for w in report["windows"]:
+        assert w["host"] == 1 and w["skew_class"] == "loader"
+        assert w["wall_median_s"] == pytest.approx(1.5)
+    assert report["per_host_totals"][1]["loader_wait_s"] == pytest.approx(5.0)
+    # CLI end-to-end (table + json modes both exit 0)
+    assert mod.main([str(d)]) == 0
+    assert mod.main([str(d), "--json"]) == 0
+    # two files claiming the same rank would merge two hosts into a
+    # chimera — refused with the documented nonzero exit
+    assert mod.main([str(d / "steps.rank1.jsonl"),
+                     str(d / "steps.rank1.jsonl")]) == 2
+    # a typo'd/deleted explicit path degrades to a clean exit-2, not a
+    # traceback (the dead-run salvage norm); readable siblings still merge
+    assert mod.main([str(d / "steps.rank9.jsonl")]) == 2
+    assert mod.main([str(d), str(d / "nope" / "steps.rank7.jsonl")]) == 0
+    # streams with NO common step: loaded, but nothing aligns -> exit 2
+    d2 = tmp_path / "disjoint"
+    d2.mkdir()
+    _write_rank_stream(str(d2 / "steps.rank0.jsonl"), 0,
+                       walls=[1.0], loader_waits=[0.0])
+    with open(str(d2 / "steps.rank1.jsonl"), "w") as f:
+        from stoke_tpu.telemetry.events import build_step_event as _b
+
+        f.write(json.dumps(_b(
+            ts=5000.0, step=99, rank=1, window_steps=1,
+            host_dispatch_s=0.0, loader_wait_s=0.0, samples_total=1.0,
+            compiles_total=1, recompiles=0, compile_time_s=0.0,
+        )) + "\n")
+    assert mod.main([str(d2)]) == 2
