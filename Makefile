@@ -7,7 +7,7 @@
 CPU_ENV = env PYTHONPATH=$(CURDIR) JAX_PLATFORMS=cpu
 MESH_ENV = $(CPU_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-full test-fast test-telemetry test-collectives test-health test-attribution test-fleet dryrun bench-smoke telemetry-smoke tpu-probe
+.PHONY: test test-full test-fast test-telemetry test-collectives test-health test-attribution test-fleet test-autotune autotune-smoke dryrun bench-smoke telemetry-smoke tpu-probe
 
 test:            ## default tier (excludes @slow compile-heavy equivalence tests)
 	$(MESH_ENV) python -m pytest tests/ -x -q
@@ -35,6 +35,12 @@ test-attribution: ## step-time attribution tests only (CostCards/MFU/goodput/aut
 
 test-fleet:      ## fleet-observability tests only (skew aggregation/stragglers/barrier attribution)
 	$(MESH_ENV) python -m pytest tests/ -x -q -m fleet
+
+test-autotune:   ## autotuner + compile-cache tests only (search/pruning/ledger/warm starts)
+	$(MESH_ENV) python -m pytest tests/ -x -q -m autotune
+
+autotune-smoke:  ## CPU-safe autotune sweep smoke (>= 4 subprocess trials, never touches the tunnel)
+	$(CPU_ENV) python scripts/autotune.py --smoke --no-persist
 
 bench-smoke:     ## CPU-safe bench smoke (never touches the tunnel)
 	$(CPU_ENV) python bench.py --preset tiny

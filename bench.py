@@ -173,7 +173,7 @@ REGRESSION_TOLERANCE = 0.05
 #: regression
 _REGRESSION_CONFIG_KEYS = (
     "xla_flags", "steps_per_dispatch", "comm_dtype", "health",
-    "attribution", "fleet",
+    "attribution", "fleet", "tuned",
 )
 
 
@@ -219,6 +219,17 @@ def check_regression(
                 )
         return out
     return None
+
+
+def _missing_flag_tokens(requested: str, env_flags: str) -> list:
+    """The whitespace-split tokens of ``requested`` not already present
+    in ``env_flags`` — exact-token comparison, because a substring test
+    would treat ``--flag=1`` as present inside an ambient ``--flag=16``
+    and silently skip exporting it (a mislabeled measurement)."""
+    if not requested:
+        return []
+    env_tokens = set(env_flags.split())
+    return [t for t in requested.split() if t not in env_tokens]
 
 
 #: sentinel: probe succeeded but only the CPU backend is visible
@@ -467,12 +478,65 @@ def main():
                     "the ledger descriptor records the skew columns.  A "
                     "distinct configuration for the stale-substitution "
                     "and regression guards")
+    ap.add_argument("--tuned", action="store_true",
+                    help="replay the autotune ledger winner (ISSUE 6): "
+                    "apply its xla_flags/batch/steps_per_dispatch "
+                    "(explicit --batch/--seg still win) and run with the "
+                    "persistent AOT compile cache enabled so warm starts "
+                    "reclaim compile seconds.  The capture's ledger "
+                    "descriptor records tuned/cache_hit columns — a "
+                    "distinct configuration for the stale-substitution "
+                    "and regression guards")
     ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
+    tuned_rec = None
+    if args.tuned:
+        # preset-aware lookup (same preset -> metric rule _supervise
+        # uses): the tiny preset replays the smoke winner, never the
+        # ResNet one — a winner's knobs only make sense for the workload
+        # they were measured on
+        tuned_metric = (
+            "cifar10_basicnn_train_throughput"
+            if args.preset == "tiny" else METRIC
+        )
+        tuned_rec = _load_results().get(f"autotune/{tuned_metric}")
+        if tuned_rec is None:
+            print(json.dumps({
+                "metric": tuned_metric,
+                "value": 0.0,
+                "error": "--tuned requested but no autotune winner is "
+                "persisted for this preset's metric; run "
+                "scripts/autotune.py first",
+            }))
+            sys.exit(1)
+        spec = tuned_rec.get("spec") or {}
+        # winner knobs become the run defaults (explicit flags still win)
+        if not args.xla_flags and spec.get("xla_flags"):
+            args.xla_flags = spec["xla_flags"]
+        if args.batch is None and spec.get("batch"):
+            args.batch = int(spec["batch"])
+        if args.seg is None and spec.get("steps_per_dispatch"):
+            args.seg = int(spec["steps_per_dispatch"])
+        if args.comm_dtype is None and spec.get("comm_dtype"):
+            args.comm_dtype = spec["comm_dtype"]
     if not args._worker:
+        # XLA_FLAGS must be in the WORKER's environment at interpreter
+        # start: flags are fixed at backend init, and the ambient
+        # sitecustomize can import jax before worker code runs.  Setting
+        # them here (the parent never imports jax) is the only reliable
+        # path — the worker's own env mutation (the old bench.py:500)
+        # silently failed whenever jax beat it to the import.
+        missing = _missing_flag_tokens(
+            args.xla_flags, os.environ.get("XLA_FLAGS", "")
+        )
+        if missing:
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") + " " + " ".join(missing)
+            ).strip()
         sys.exit(_supervise(
             sys.argv[1:], args.preset,
             requested={
+                "tuned": True if args.tuned else None,
                 "fleet": True if args.fleet else None,
                 "health": True if args.health else None,
                 "attribution": (
@@ -497,10 +561,28 @@ def main():
             },
         ))
 
-    if args.xla_flags:
-        # must land before the jax import below initializes the backend
+    missing_flags = _missing_flag_tokens(
+        args.xla_flags, os.environ.get("XLA_FLAGS", "")
+    )
+    if missing_flags:
+        # the supervisor already exported the flags into this worker's
+        # start environment; reaching here means bench ran worker-direct
+        # (scripts/tpu_session.py) or someone stripped the env.  Setting
+        # XLA_FLAGS now only works if jax has NOT been imported yet —
+        # after import the backend config is frozen and the flags would
+        # silently not apply (the old bench.py:500 bug).  Warn LOUDLY in
+        # that case instead of emitting a mislabeled measurement.
+        if "jax" in sys.modules:
+            print(
+                f"bench.py WARNING: --xla-flags {args.xla_flags!r} "
+                f"requested but jax is already imported in this process; "
+                f"the flags will NOT apply to this measurement. Re-exec "
+                f"through the bench supervisor (drop --_worker) or export "
+                f"XLA_FLAGS before the interpreter starts.",
+                file=sys.stderr, flush=True,
+            )
         os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "") + " " + args.xla_flags
+            os.environ.get("XLA_FLAGS", "") + " " + " ".join(missing_flags)
         ).strip()
 
     import numpy as np
@@ -567,6 +649,18 @@ def main():
         from stoke_tpu import FleetConfig
 
         run_configs.append(FleetConfig(window_steps=10))
+    if args.tuned:
+        # tuned arm (ISSUE 6): replay the autotune winner with the
+        # persistent compile cache enabled — a warm start's backend
+        # compiles load from the XLA disk cache instead of re-running
+        # codegen (step programs still dispatch through plain jax.jit),
+        # and the capture records the hit/miss counts alongside the
+        # winner's config key
+        from stoke_tpu import CompileConfig
+
+        run_configs.append(CompileConfig(
+            cache_dir=os.path.join(_REPO, "artifacts", "compile_cache"),
+        ))
     stoke = Stoke(
         model=model,
         optimizer=StokeOptimizer(
@@ -709,6 +803,15 @@ def main():
             None if verdict.get("barrier_wait_s") is None
             else round(verdict["barrier_wait_s"], 4)
         )
+    if args.tuned:
+        # tuned/cache columns (ISSUE 6): the winner being replayed and
+        # whether this capture warm-started from the compile cache
+        cc = stoke.compile_cache
+        result["tuned"] = True
+        result["tuned_config_key"] = (tuned_rec or {}).get("config_key")
+        result["cache_hit"] = cc.hits
+        result["cache_miss"] = cc.misses
+        result["cache_saved_compile_s"] = round(cc.saved_compile_s, 3)
     if args.health or args.attribution_peak_tflops or args.fleet:
         stoke.close_telemetry()
     if on_accel:
@@ -719,6 +822,7 @@ def main():
                 "xla_flags": args.xla_flags or None,
                 "steps_per_dispatch": per_call,
                 "comm_dtype": args.comm_dtype,
+                "tuned": True if args.tuned else None,
                 "health": True if args.health else None,
                 "attribution": (
                     True if args.attribution_peak_tflops else None
@@ -755,6 +859,16 @@ def main():
                 "backend": jax.default_backend(),
                 **({"xla_flags": args.xla_flags} if args.xla_flags else {}),
                 **({"comm_dtype": args.comm_dtype} if args.comm_dtype else {}),
+                **(
+                    {
+                        "tuned": True,
+                        "tuned_config_key": result["tuned_config_key"],
+                        "cache_hit": result["cache_hit"],
+                        "cache_miss": result["cache_miss"],
+                    }
+                    if args.tuned
+                    else {}
+                ),
                 **(
                     {
                         "health": True,

@@ -1,0 +1,498 @@
+"""Telemetry-driven autotune sweep (ISSUE 6): search the exposed knobs,
+score on MFU + goodput, persist the winner in the BENCH ledger.
+
+The parent process NEVER imports jax (``XLA_FLAGS`` are fixed at backend
+init, so every trial must be its own process — the discipline
+``scripts/profile_capture.py`` established).  Each trial is a subprocess
+whose environment carries the trial's flags; the worker builds a Stoke
+run with the telemetry + attribution vertical enabled, measures
+throughput via delta timing, and reports ``value`` / ``mfu`` /
+``goodput_fraction`` / ``bound`` as one JSON line.  The search loop
+(``stoke_tpu.autotune.greedy_search``) prunes the knob space with the
+baseline's bound classification — a memory-bound workload does not burn
+trial budget on compute flags.
+
+Winners land in ``BENCH_RESULTS.json`` under ``autotune/<metric>`` with
+full provenance (config key, flags, measured MFU, trial count); replay
+with ``python bench.py --tuned``.
+
+Usage:
+    python scripts/autotune.py --smoke          # CPU flow validation
+    python scripts/autotune.py --trials 12      # real sweep (takes the
+                                                # tunnel lock; TPU flags)
+    python scripts/autotune.py --workload flash --seq-len 4096
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+sys.path.insert(0, HERE)
+
+
+def _load_autotune_module():
+    """Load ``stoke_tpu/autotune.py`` by FILE, not through the package:
+    ``import stoke_tpu.autotune`` executes the package ``__init__``,
+    which imports the facade and therefore jax — exactly the import the
+    jax-free parent must never pay (beyond cost, parent-side jax would
+    freeze a backend whose XLA_FLAGS no trial chose)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_stoke_autotune_standalone",
+        os.path.join(REPO, "stoke_tpu", "autotune.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    # dataclass field-type resolution looks the class's module up in
+    # sys.modules — register before exec
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_autotune = _load_autotune_module()
+TPU_XLA_FLAG_CANDIDATES = _autotune.TPU_XLA_FLAG_CANDIDATES
+SearchOutcome = _autotune.SearchOutcome
+TrialResult = _autotune.TrialResult
+TrialSpec = _autotune.TrialSpec
+greedy_search = _autotune.greedy_search
+persist_winner = _autotune.persist_winner
+
+LEDGER_DEFAULT = os.path.join(REPO, "BENCH_RESULTS.json")
+RESNET_METRIC = "cifar10_resnet50_bf16_train_throughput"
+SMOKE_METRIC = "cifar10_basicnn_train_throughput"
+FLASH_METRIC = "flash_attention_fwdbwd_tokens_per_s"
+
+
+def _parse_int_list(text: str) -> list:
+    return [int(v) for v in text.split(",") if v.strip()]
+
+
+# --------------------------------------------------------------------------- #
+# trial worker (its own process: XLA_FLAGS are already in its environment)
+# --------------------------------------------------------------------------- #
+
+
+def _run_trial(payload: dict) -> dict:
+    """Measure ONE trial.  Runs inside the subprocess the driver spawned;
+    prints nothing itself — returns the result record the caller emits."""
+    import numpy as np
+
+    import jax
+
+    spec = TrialSpec.from_dict(payload["spec"])
+    steps = int(payload["steps"])
+    warmup = int(payload["warmup"])
+    on_accel = jax.default_backend() not in ("cpu",)
+    out = {
+        "trial": True,
+        "config_key": spec.config_key(),
+        "on_accelerator": on_accel,
+        "ok": True,
+    }
+
+    if payload["workload"] == "flash":
+        return {**out, **_measure_flash(spec, payload, steps, warmup)}
+
+    import optax
+
+    from stoke_tpu import (
+        AttributionConfig,
+        CommConfig,
+        Stoke,
+        StokeOptimizer,
+        TelemetryConfig,
+    )
+    from stoke_tpu.models import BasicNN, ResNet50
+    from stoke_tpu.telemetry import read_step_events
+    from stoke_tpu.utils import init_module
+
+    smoke = payload["workload"] == "smoke"
+    # dp is a SWEEP-level decision, not a per-trial one: when any trial
+    # sweeps comm_dtype, every trial (baseline included) runs under
+    # distributed="dp" so the score compares wire formats, never the
+    # dp/no-dp switch itself
+    use_dp = bool(payload.get("dp") or spec.comm_dtype)
+    batch = spec.batch or (8 if smoke else 256)
+    seg = spec.steps_per_dispatch or (2 if smoke else 10)
+    model = BasicNN() if smoke else ResNet50(num_classes=10, cifar_stem=True)
+    variables = init_module(
+        model, jax.random.PRNGKey(0),
+        np.zeros((2, 32, 32, 3), np.float32), train=False,
+    )
+    obs_dir = tempfile.mkdtemp(prefix="stoke-autotune-obs-")
+    configs = [
+        TelemetryConfig(
+            output_dir=obs_dir, log_every_n_steps=1,
+            prometheus=False, tensorboard=False, sample_device_time=False,
+        ),
+        AttributionConfig(peak_tflops=float(payload["peak_tflops"])),
+    ]
+    if spec.comm_dtype:
+        configs.append(CommConfig(dtype=spec.comm_dtype))
+    stoke = Stoke(
+        model=model,
+        optimizer=StokeOptimizer(
+            optimizer=optax.sgd,
+            optimizer_kwargs={"learning_rate": 0.05, "momentum": 0.9},
+        ),
+        loss=lambda lo, la: optax.softmax_cross_entropy_with_integer_labels(
+            lo, la
+        ).mean(),
+        params=variables,
+        batch_size_per_device=batch,
+        device="tpu" if on_accel else "cpu",
+        distributed="dp" if use_dp else None,
+        precision=None if smoke else "bf16",
+        configs=configs,
+        model_train_kwargs={"train": True},
+        model_eval_kwargs={"train": False},
+        verbose=False,
+    )
+    r = np.random.default_rng(0)
+    xs = jax.device_put(
+        r.normal(size=(seg, batch, 32, 32, 3)).astype(np.float32)
+    )
+    ys = jax.device_put(r.integers(0, 10, size=(seg, batch)))
+
+    def timed(n):
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(n):
+            last = stoke.train_steps(xs, (ys,))
+        np.asarray(jax.tree_util.tree_leaves(last)[0])  # force a fetch
+        return time.perf_counter() - t0
+
+    for _ in range(warmup):
+        stoke.train_steps(xs, (ys,))
+    timed(1)
+    t1 = timed(steps)
+    t2 = timed(2 * steps)
+    dt = max(t2 - t1, 1e-9)
+    value = batch * seg * steps / dt
+    goodput = stoke.goodput or {}
+    stoke.close_telemetry()
+    bound = None
+    try:
+        records = read_step_events(os.path.join(obs_dir, "steps.jsonl"))
+        for rec in reversed(records):
+            if rec.get("bound") is not None:
+                bound = rec["bound"]
+                break
+    except Exception:
+        pass
+    return {
+        **out,
+        "value": round(value, 1),
+        "unit": "imgs/sec/chip",
+        "mfu": goodput.get("mfu"),
+        "goodput_fraction": goodput.get("goodput_fraction"),
+        "bound": bound,
+        "wall_s": round(dt, 4),
+        "batch": batch,
+        "steps_per_dispatch": seg,
+    }
+
+
+def _measure_flash(spec: TrialSpec, payload: dict, steps: int,
+                   warmup: int) -> dict:
+    """Flash-attention block-size trial: fwd+bwd latency of the Pallas
+    kernel at the spec's blocking (interpret mode on CPU — tiny sizes
+    only; real sweeps run on the chip)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from stoke_tpu.ops.flash_attention import flash_attention
+
+    on_cpu = jax.default_backend() == "cpu"
+    L = int(payload["seq_len"])
+    B, H, D = (1, 2, 64) if on_cpu else (4, 8, 64)
+    r = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(r.normal(size=(B, H, L, D)).astype(np.float32))
+        for _ in range(3)
+    )
+
+    def loss(q, k, v):
+        o = flash_attention(
+            q, k, v, causal=True,
+            block_q=spec.flash_block_q, block_k=spec.flash_block_k,
+            interpret=on_cpu,
+        )
+        return (o * o).sum()
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    for _ in range(max(warmup, 1)):
+        jax.block_until_ready(g(q, k, v))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = g(q, k, v)
+    jax.block_until_ready(out)
+    dt = max(time.perf_counter() - t0, 1e-9)
+    return {
+        "value": round(B * L * steps / dt, 1),
+        "unit": "tokens/sec",
+        "mfu": None,
+        "goodput_fraction": None,
+        "bound": None,
+        "wall_s": round(dt, 4),
+    }
+
+
+# --------------------------------------------------------------------------- #
+# driver (jax-free)
+# --------------------------------------------------------------------------- #
+
+
+def _subprocess_measure(payload_base: dict, timeout: int, verbose: bool,
+                        require_accel: bool = False):
+    """Build the measure() callable the search loop drives: one fresh
+    subprocess per trial so the trial's XLA_FLAGS land before jax import
+    (flags are fixed at backend init — the bench.py:500 bug this PR
+    fixes was exactly an in-process mutation after import)."""
+
+    def measure(spec: TrialSpec) -> TrialResult:
+        payload = {**payload_base, "spec": spec.to_dict()}
+        env = dict(os.environ)
+        if spec.xla_flags:
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "") + " " + spec.xla_flags
+            ).strip()
+        try:
+            proc = subprocess.run(
+                [
+                    sys.executable, os.path.abspath(__file__),
+                    "--_trial", json.dumps(payload),
+                ],
+                capture_output=True, text=True, timeout=timeout, env=env,
+            )
+        except subprocess.TimeoutExpired:
+            return TrialResult(
+                spec, ok=False, error=f"trial timed out after {timeout}s"
+            )
+        line = next(
+            (
+                ln for ln in reversed(proc.stdout.strip().splitlines())
+                if ln.startswith("{")
+            ),
+            None,
+        )
+        if proc.returncode != 0 or line is None:
+            err = (proc.stderr or "no output").strip().splitlines()
+            return TrialResult(
+                spec, ok=False,
+                error=(err[-1][:200] if err else "trial produced no JSON"),
+            )
+        rec = json.loads(line)
+        if verbose:
+            print(json.dumps(rec), flush=True)
+        if not rec.get("ok", False):
+            return TrialResult(
+                spec, ok=False, error=rec.get("error", "trial failed")
+            )
+        if require_accel and rec.get("on_accelerator") is False:
+            # tunnel down / backend fell back to CPU: the measurement is
+            # real but its knobs are meaningless for the chip — a failed
+            # trial, never a ledgered on-chip winner (the masquerade
+            # bench.py's on_accelerator checks refuse)
+            return TrialResult(
+                spec, ok=False,
+                error="trial ran on the CPU backend; refusing to score a "
+                "CPU fallback in an on-chip sweep",
+            )
+        return TrialResult(
+            spec,
+            value=float(rec.get("value", 0.0)),
+            unit=rec.get("unit", "imgs/sec/chip"),
+            mfu=rec.get("mfu"),
+            goodput_fraction=rec.get("goodput_fraction"),
+            bound=rec.get("bound"),
+            wall_s=rec.get("wall_s"),
+        )
+
+    return measure
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--_trial", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CPU flow validation: BasicNN, tiny knob space, "
+                    ">= 4 trials, winner persisted under the smoke metric")
+    ap.add_argument("--workload", choices=["resnet", "flash"],
+                    default="resnet")
+    ap.add_argument("--trials", type=int, default=12,
+                    help="total trial budget (baseline included)")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="timed train_steps dispatches per trial")
+    ap.add_argument("--warmup", type=int, default=None)
+    ap.add_argument("--batches", default=None,
+                    help="comma-separated batch candidates")
+    ap.add_argument("--segs", default=None,
+                    help="comma-separated steps_per_dispatch candidates")
+    ap.add_argument("--xla-flag-candidates", default=None,
+                    help="';'-separated XLA_FLAGS fragment candidates "
+                    "(default: the curated TPU set; empty string = none)")
+    ap.add_argument("--comm-dtypes", default=None,
+                    help="comma-separated wire dtypes to sweep (e.g. "
+                    "bf16,int8); default: not swept")
+    ap.add_argument("--flash-blocks", default=None,
+                    help="flash block-size candidates (workload=flash; "
+                    "default 128,256,512, smoke 64,128)")
+    ap.add_argument("--seq-len", type=int, default=None,
+                    help="sequence length for workload=flash")
+    ap.add_argument("--peak-tflops", type=float, default=None,
+                    help="MFU denominator for trial attribution "
+                    "(default: 197 = v5e bf16 dense; smoke: 1e-3)")
+    ap.add_argument("--ledger", default=LEDGER_DEFAULT,
+                    help="BENCH ledger path the winner persists into")
+    ap.add_argument("--trial-timeout", type=int, default=900)
+    ap.add_argument("--no-persist", action="store_true",
+                    help="run the sweep but skip the ledger write")
+    args = ap.parse_args()
+
+    if args._trial is not None:
+        # worker mode: measure one spec, emit one JSON line, exit
+        payload = json.loads(args._trial)
+        try:
+            rec = _run_trial(payload)
+        except Exception as e:  # the driver scores failures, not tracebacks
+            rec = {
+                "trial": True, "ok": False,
+                "config_key": TrialSpec.from_dict(
+                    payload.get("spec", {})
+                ).config_key(),
+                "error": f"{type(e).__name__}: {e}"[:300],
+            }
+        print(json.dumps(rec), flush=True)
+        return 0 if rec.get("ok") else 1
+
+    smoke = args.smoke
+    flash = args.workload == "flash"
+    if flash:
+        # smoke runs persist under their own metric: a CPU interpret-mode
+        # winner must never masquerade as a real on-chip flash record
+        metric = FLASH_METRIC + ("_smoke" if smoke else "")
+        blocks = _parse_int_list(
+            args.flash_blocks or ("64,128" if smoke else "128,256,512")
+        )
+        space = {"flash_block_q": blocks, "flash_block_k": blocks}
+        base = TrialSpec(flash_block_q=blocks[0], flash_block_k=blocks[0])
+    else:
+        # baselines carry the workload defaults EXPLICITLY (batch 8/256,
+        # seg 2/10 — what the worker would fall back to anyway) so the
+        # config-key dedup skips candidates that merely restate them: a
+        # real on-chip trial is minutes of tunnel time, and re-measuring
+        # the baseline under a different key wastes budget
+        metric = SMOKE_METRIC if smoke else RESNET_METRIC
+        if smoke:
+            space = {
+                "batch": args.batches and _parse_int_list(args.batches)
+                or [16, 32],
+                "steps_per_dispatch": args.segs and _parse_int_list(args.segs)
+                or [4, 8],
+            }
+            base = TrialSpec(batch=8, steps_per_dispatch=2)
+        else:
+            space = {
+                "xla_flags": (
+                    args.xla_flag_candidates.split(";")
+                    if args.xla_flag_candidates is not None
+                    else list(TPU_XLA_FLAG_CANDIDATES)
+                ),
+                "batch": _parse_int_list(args.batches or "128,256,512"),
+                "steps_per_dispatch": _parse_int_list(args.segs or "10,25,50"),
+            }
+            if args.comm_dtypes:
+                space["comm_dtype"] = [
+                    d for d in args.comm_dtypes.split(",") if d.strip()
+                ]
+            base = TrialSpec(batch=256, steps_per_dispatch=10)
+
+    payload_base = {
+        "workload": "smoke" if (smoke and not flash) else args.workload,
+        "steps": args.steps or (2 if smoke else 10),
+        "warmup": args.warmup if args.warmup is not None else (1 if smoke else 2),
+        "peak_tflops": (
+            args.peak_tflops
+            if args.peak_tflops is not None
+            else (1e-3 if smoke else 197.0)
+        ),
+        "seq_len": args.seq_len or (128 if smoke else 4096),
+        # dp for EVERY trial of a comm sweep (baseline included), so the
+        # comm_dtype knob is measured against a dp baseline instead of
+        # confounding the wire format with the dp/no-dp switch
+        "dp": "comm_dtype" in space,
+    }
+
+    # tunnel discipline: a real (non-smoke) sweep dials the single-client
+    # TPU relay once per trial — take the shared lock for the whole sweep
+    # so the watcher/bench never double-dial mid-search
+    lock_taken = False
+    if not smoke:
+        import bench
+
+        lock_taken, holder = bench._try_acquire_tunnel_lock()
+        if not lock_taken and holder is not None:
+            print(json.dumps({
+                "autotune": "blocked",
+                "error": f"tunnel held by live session (pid {holder})",
+            }))
+            return 1
+    try:
+        measure = _subprocess_measure(
+            payload_base, args.trial_timeout, verbose=True,
+            # a real sweep's winner is an on-chip record: CPU-fallback
+            # trials (tunnel down, no visible accelerator) must fail
+            # rather than ledger CPU knobs under backend="tpu"
+            require_accel=not smoke,
+        )
+        outcome = greedy_search(
+            measure, base, space, max_trials=args.trials,
+            log=lambda m: print(f"autotune: {m}", flush=True),
+        )
+    finally:
+        if lock_taken:
+            import bench
+
+            try:
+                os.remove(bench._TUNNEL_LOCK)
+            except OSError:
+                pass
+
+    best = outcome.best
+    summary = {
+        "autotune": "ok" if best.ok else "FAILED",
+        "metric": metric,
+        "trials": outcome.trials,
+        "pruned_knobs": outcome.pruned_knobs,
+        "winner": best.to_dict(),
+    }
+    if best.ok and not args.no_persist:
+        backend = "cpu" if smoke else "tpu"
+        record = persist_winner(
+            args.ledger, metric, outcome, backend=backend,
+            extra={"workload": payload_base["workload"]},
+        )
+        summary["persisted"] = {
+            "ledger": args.ledger,
+            "key": f"autotune/{metric}",
+            "config_key": record["config_key"],
+        }
+    print(json.dumps(summary), flush=True)
+    return 0 if best.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

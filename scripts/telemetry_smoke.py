@@ -74,6 +74,40 @@ def main() -> int:
     bundle = stoke.health.dump("smoke")
     stoke.close_telemetry()
 
+    # persistent compile cache (ISSUE 6): one cached warm-start
+    # end-to-end — a cold construction misses and persists, a second
+    # construction hits the ledger, and the step outputs are
+    # bit-identical between the two
+    from stoke_tpu import CompileConfig
+
+    cc_dir = os.path.join(out_dir, "compile_cache")
+
+    def _cc_run():
+        s = Stoke(
+            model=lambda p, x: x @ p["w"],
+            optimizer=StokeOptimizer(
+                optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.1}
+            ),
+            loss=lambda o, y: ((o - y) ** 2).mean(),
+            params={"w": np.full((8, 4), 0.5, np.float32)},
+            batch_size_per_device=16,
+            configs=[CompileConfig(cache_dir=cc_dir)],
+            verbose=False,
+        )
+        s.train_step(x, (y,))
+        return s
+
+    cc_cold = _cc_run()
+    cc_warm = _cc_run()
+    compile_cache_ok = (
+        cc_cold.compile_cache.misses >= 1
+        and cc_warm.compile_cache.hits >= 1
+        and cc_warm.compile_cache.saved_compile_s > 0
+        and np.array_equal(
+            np.asarray(cc_cold.params["w"]), np.asarray(cc_warm.params["w"])
+        )
+    )
+
     records = read_step_events(os.path.join(out_dir, "steps.jsonl"))
     print(json.dumps(records[-1], sort_keys=True))
     rec = records[-1]
@@ -142,6 +176,7 @@ def main() -> int:
         and any(t.startswith("telemetry/") for t, _, _ in tb_events)
         and bundle_ok
         and {"sentinels", "step_event"} <= ring_kinds
+        and compile_cache_ok
     )
     print(json.dumps({
         "telemetry_smoke": "ok" if ok else "FAILED",
@@ -158,6 +193,8 @@ def main() -> int:
         "fleet_hosts": rec.get("fleet/hosts"),
         "fleet_windows": fleet.get("windows"),
         "fleet_skew_class": rec.get("fleet/skew_class"),
+        "compile_cache_cold": cc_cold.compile_cache.stats(),
+        "compile_cache_warm": cc_warm.compile_cache.stats(),
     }))
     return 0 if ok else 1
 

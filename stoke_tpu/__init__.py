@@ -14,6 +14,7 @@ from stoke_tpu.configs import (
     ClipGradConfig,
     ClipGradNormConfig,
     CommConfig,
+    CompileConfig,
     DataParallelConfig,
     DeviceOptions,
     DistributedInitConfig,
@@ -83,6 +84,7 @@ __all__ = [
     "ClipGradConfig",
     "ClipGradNormConfig",
     "CommConfig",
+    "CompileConfig",
     "DataParallelConfig",
     "MeshConfig",
     "DistributedInitConfig",

@@ -923,6 +923,88 @@ class FleetConfig:
 
 
 @dataclass
+class CompileConfig:
+    """Persistent compilation cache + AOT-lowered step programs (ISSUE 6
+    tentpole).
+
+    No reference equivalent (torch eager has no compile step to cache).
+    TPU-native motivation: warm-up XLA compilation of the step programs is
+    tens of seconds of pure ``goodput_compile_s`` on every restart of an
+    identical job (arXiv:1810.09868 demonstrates full-AOT feasibility for
+    exactly these programs; the TPU serving comparison arXiv:2605.25645
+    attributes much of TPU's production edge to compile-and-cache
+    discipline).  Default OFF — without this config the engine dispatches
+    its ``jax.jit`` programs exactly as before, bit-identical HLO.
+
+    With it on, three layers engage — all dispatching through ordinary
+    ``jax.jit`` (donation, async dispatch, and numerics byte-for-byte
+    the no-cache path):
+
+    1. **Process program cache** (always with ``aot=True``): a second
+       ``Stoke`` construction in the same process whose step programs
+       lower to identical HLO dispatches through the first facade's
+       already-compiled jit fns — zero recompilation, every backend.
+    2. **XLA persistent cache** (``xla_cache=True``, non-CPU backends):
+       the process-global jax compilation cache is pointed at
+       ``<cache_dir>/xla`` so a warm PROCESS's backend compiles load
+       from disk in milliseconds instead of re-running XLA codegen.
+       Refused on CPU — this jaxlib's CPU cache serialization corrupts
+       the heap for sharded/donated programs (the compile_cache module
+       docstring pins the evidence).
+    3. **AOT program ledger** (``aot=True``): each step program (accum /
+       fused / window / multi / apply) is lowered at first dispatch and
+       keyed by a sha256 of the **lowered HLO text** plus an environment
+       fingerprint (jax/jaxlib versions, backend, ``XLA_FLAGS``,
+       topology, process count — see
+       ``stoke_tpu.compile_cache.environment_fingerprint``).  Per key, a
+       ``<cache_dir>/exe-<key>.json`` provenance marker records the cold
+       first-dispatch seconds; a warm start reports a
+       ``compile_cache_hit``, credits the recorded seconds as reclaimed,
+       and the goodput ledger splits its compile bucket into
+       ``compile_fresh`` vs ``compile_cached``.  On a miss the compiled
+       executable is additionally serialized to ``exe-<key>.bin`` as an
+       offline AOT artifact (when a live XLA cache absorbs the extra
+       compile).
+
+    Step programs deliberately never dispatch through deserialized
+    executables: on current jax, ``deserialize_and_load`` loses the
+    donated-input bookkeeping, and chaining such calls over carried
+    training state silently corrupts numerics (tests enforce the safe
+    architecture).  Keying on the lowered HLO is what makes the ledger
+    safe: any change in model code, loss math, optimizer hyperparameters
+    (constants in the HLO), shapes, shardings, or precision changes the
+    key — a warm start can never be served different math.
+
+    Attributes:
+        cache_dir: cache directory (created if missing; status-validated
+            writable).  Shareable across runs/processes — entries are
+            content-addressed and written atomically.
+        aot: enable the AOT program ledger + process program cache
+            (layers 1 and 3 above — warm-start serving, hit/miss
+            accounting, serialized artifacts).
+        xla_cache: point the process-global jax persistent compilation
+            cache at ``<cache_dir>/xla`` (layer 2 above; non-CPU
+            backends).  Process-global by nature; the FIRST run to
+            install wins, and every later run in the process shares it
+            (content-addressed, so sharing is always safe).
+        serialize_executables: also write the ``exe-<key>.bin``
+            serialized-executable artifact on each ledger miss (for
+            offline AOT use; skipped automatically when no live XLA
+            cache would absorb the extra compile).
+        min_compile_time_s: only persist XLA-cache entries whose compile
+            took at least this long (forwarded to
+            ``jax_persistent_cache_min_compile_time_secs``; 0 caches
+            everything — right for tests and the CPU mesh).
+    """
+
+    cache_dir: str = "compile_cache"
+    aot: bool = True
+    xla_cache: bool = True
+    serialize_executables: bool = True
+    min_compile_time_s: float = 0.0
+
+
+@dataclass
 class ProfilerConfig:
     """First-class profiling (SURVEY.md §5: native win over the reference's
     DeepSpeed flops-profiler passthrough, configs.py:252-279).
@@ -967,6 +1049,7 @@ ALL_CONFIG_CLASSES: Tuple[type, ...] = (
     ClipGradConfig,
     ClipGradNormConfig,
     CommConfig,
+    CompileConfig,
     DataParallelConfig,
     MeshConfig,
     DistributedInitConfig,

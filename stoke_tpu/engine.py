@@ -462,6 +462,15 @@ class StepEngine:
         # signature and account analytic FLOPs/bytes per dispatch.  None
         # -> zero bookkeeping, programs untouched.
         self._attribution = None
+        # persistent AOT compile cache (ISSUE 6): assigned by the facade
+        # when a CompileConfig is supplied.  Each step-program dispatch
+        # site resolves its callable through _aot_call: with a cache, the
+        # first dispatch per (program key, shape signature) lowers the
+        # jitted fn and checks the HLO-keyed program ledger (warm-start
+        # hit accounting; the persistent XLA cache serves the backend
+        # compile), then dispatches through the jitted fn as always.
+        # None -> zero bookkeeping, dispatch untouched.
+        self._compile_cache = None
         # shardings, resolved lazily once variables are known
         self._var_shardings = None
         self._grad_shardings = None
@@ -720,7 +729,11 @@ class StepEngine:
         hook (:meth:`_note_cost`) reuses it instead of recomputing it on
         the dispatch hot path; None when nobody needs one."""
         tracker = self._compile_tracker
-        if tracker is None and self._attribution is None:
+        if (
+            tracker is None
+            and self._attribution is None
+            and self._compile_cache is None
+        ):
             return None
         sig = self._shape_sig(batch_trees)
         if tracker is None:
@@ -746,6 +759,21 @@ class StepEngine:
         if attr is None:
             return
         attr.note_dispatch((key, sig or ()), program, fn, args, steps)
+
+    def _aot_call(self, program: str, key, sig: Optional[tuple], fn,
+                  args: tuple):
+        """Compile-cache hook (ISSUE 6): resolve the callable that will
+        run this dispatch.  ``fn`` itself without a cache; with one, the
+        first dispatch per (program key, shape signature) goes through
+        the cache's HLO-keyed program ledger — which books the warm-start
+        hit (the persistent XLA cache serves the impending backend
+        compile) or records the cold cost — and every later dispatch is
+        ``fn`` untouched.  Dispatch semantics (donation, async, numerics)
+        are ALWAYS plain ``jax.jit``."""
+        cache = self._compile_cache
+        if cache is None:
+            return fn
+        return cache.executable(program, (key, sig), fn, args)
 
     # -------------------------- fused micro-step ----------------------- #
 
@@ -794,9 +822,14 @@ class StepEngine:
              loss_args_flat),
             0, sig,
         )
+        call = self._aot_call(
+            "accum", struct_key, sig, self._accum_cache[struct_key],
+            (variables, grad_buf, scaler_state, rng, margs, mkwargs,
+             loss_args_flat),
+        )
         self.dispatch_count += 1
         with xprof_span("stoke/accum"):
-            return self._accum_cache[struct_key](
+            return call(
                 variables, grad_buf, scaler_state, rng, margs, mkwargs,
                 loss_args_flat,
             )
@@ -1036,9 +1069,14 @@ class StepEngine:
              margs_stacked, mkwargs_stacked, loss_args_flat_stacked),
             1, sig,
         )
+        call = self._aot_call(
+            "window", key, sig, self._accum_cache[key],
+            (variables, opt_state, grad_buf, scaler_state, comm_state, rng,
+             margs_stacked, mkwargs_stacked, loss_args_flat_stacked),
+        )
         self.dispatch_count += 1
         with xprof_span("stoke/dispatch"):
-            return self._accum_cache[key](
+            return call(
                 variables, opt_state, grad_buf, scaler_state, comm_state,
                 rng, margs_stacked, mkwargs_stacked, loss_args_flat_stacked,
             )
@@ -1177,9 +1215,14 @@ class StepEngine:
                  loss_args_flat_stacked),
                 int(n_steps), sig,
             )
+        call = self._aot_call(
+            "multi", key, sig, self._accum_cache[key],
+            (variables, opt_state, grad_buf, scaler_state, comm_state, rng,
+             margs_stacked, mkwargs_stacked, loss_args_flat_stacked),
+        )
         self.dispatch_count += 1
         with xprof_span("stoke/dispatch"):
-            return self._accum_cache[key](
+            return call(
                 variables, opt_state, grad_buf, scaler_state, comm_state,
                 rng, margs_stacked, mkwargs_stacked, loss_args_flat_stacked,
             )
@@ -1257,9 +1300,14 @@ class StepEngine:
              loss_val),
             1, (),
         )
+        call = self._aot_call(
+            "apply", "apply", (), self._apply_fn,
+            (variables, opt_state, grad_buf, scaler_state, comm_state,
+             loss_val),
+        )
         self.dispatch_count += 1
         with xprof_span("stoke/step"):
-            return self._apply_fn(
+            return call(
                 variables, opt_state, grad_buf, scaler_state, comm_state,
                 loss_val,
             )
@@ -1425,8 +1473,13 @@ class StepEngine:
                  rng, margs, mkwargs, loss_args_flat),
                 1, sig,
             )
+            call = self._aot_call(
+                "fused", key, sig, self._accum_cache[key],
+                (variables, opt_state, grad_buf, scaler_state, comm_state,
+                 rng, margs, mkwargs, loss_args_flat),
+            )
             with xprof_span("stoke/dispatch"):
-                return self._accum_cache[key](
+                return call(
                     variables, opt_state, grad_buf, scaler_state, comm_state,
                     rng, margs, mkwargs, loss_args_flat,
                 )
@@ -1440,9 +1493,14 @@ class StepEngine:
              loss_args_flat),
             0, sig,
         )
+        call = self._aot_call(
+            "fused_nb", key, sig, self._accum_cache[key],
+            (variables, grad_buf, scaler_state, rng, margs, mkwargs,
+             loss_args_flat),
+        )
         with xprof_span("stoke/dispatch"):
             (report, updated, new_vars, new_buf, new_scaler, new_rng,
-             finite) = self._accum_cache[key](
+             finite) = call(
                 variables, grad_buf, scaler_state, rng, margs, mkwargs,
                 loss_args_flat,
             )

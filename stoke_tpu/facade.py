@@ -435,6 +435,24 @@ class Stoke:
         self._engine._compile_tracker = self._telemetry.compile_tracker
         self._last_grad_norm: Optional[float] = None
 
+        # ----- persistent AOT compile cache (ISSUE 6: warm starts load
+        #       backend compiles from the persistent XLA disk cache and
+        #       the HLO-keyed program ledger books the reclaimed seconds;
+        #       step programs ALWAYS dispatch through plain jax.jit —
+        #       never through deserialized executables, which lose
+        #       donated-input bookkeeping.  Default OFF — without a
+        #       CompileConfig the engine dispatches exactly as before)
+        # -----
+        self._compile_cache = None
+        ccfg = st.compile_config
+        if ccfg is not None:
+            from stoke_tpu.compile_cache import CompileCache
+
+            self._compile_cache = CompileCache(
+                ccfg, self._telemetry.registry
+            )
+            self._engine._compile_cache = self._compile_cache
+
         # ----- step-time attribution & goodput (ISSUE 4: CostCards, live
         #       MFU/roofline gauges, goodput ledger, anomaly-triggered
         #       xprof capture; default OFF — without an AttributionConfig
@@ -1240,6 +1258,13 @@ class Stoke:
         """The run's fleet monitor (None without a ``FleetConfig``) —
         per-host signal matrix, skew aggregates, straggler streak state."""
         return self._fleet
+
+    @property
+    def compile_cache(self):
+        """The run's persistent AOT compile cache (None without a
+        ``CompileConfig``) — hit/miss counts, reclaimed compile seconds
+        (``.stats()``), and the cache directory."""
+        return self._compile_cache
 
     @property
     def fleet_summary(self) -> Optional[Dict[str, Any]]:

@@ -35,6 +35,7 @@ from stoke_tpu.configs import (
     ClipGradConfig,
     ClipGradNormConfig,
     CommConfig,
+    CompileConfig,
     DataParallelConfig,
     DeviceOptions,
     DistributedInitConfig,
@@ -604,6 +605,32 @@ class StokeStatus:
                 )
             return False
 
+        def _compile_invalid(s):
+            """Compile-cache legality (ISSUE 6): the cache directory must
+            be writable on EVERY process (each serializes its own step
+            executables), and the XLA-cache persistence threshold must be
+            a sane duration."""
+            cfg = self._configs.get("CompileConfig")
+            if cfg is None:
+                return False
+            if cfg.min_compile_time_s < 0:
+                return (
+                    f"CompileConfig.min_compile_time_s must be >= 0, got "
+                    f"{cfg.min_compile_time_s}"
+                )
+            if not (cfg.aot or cfg.xla_cache):
+                return (
+                    "CompileConfig with aot=False and xla_cache=False "
+                    "caches nothing — enable a layer or drop the config"
+                )
+            err = _probe_writable(cfg.cache_dir)
+            if err is not None:
+                return (
+                    f"CompileConfig.cache_dir {cfg.cache_dir!r} is not "
+                    f"writable: {err}"
+                )
+            return False
+
         def _offload_cpu_no_fallback(s):
             for name in ("OffloadOptimizerConfig", "OffloadParamsConfig"):
                 cfg = self._configs.get(name)
@@ -740,6 +767,10 @@ class StokeStatus:
             (
                 _fleet_invalid,
                 "FleetConfig is invalid for this combination",
+            ),
+            (
+                _compile_invalid,
+                "CompileConfig is invalid",
             ),
             (
                 _offload_cpu_no_fallback,
@@ -972,6 +1003,13 @@ class StokeStatus:
         opt-in; without it no cross-host exchange ever runs and the step
         paths are bit-identical to pre-ISSUE-5)."""
         return self._configs.get("FleetConfig")
+
+    @property
+    def compile_config(self) -> Optional[CompileConfig]:
+        """None unless explicitly supplied (the persistent compilation
+        cache is opt-in; without it the engine dispatches its jit
+        programs exactly as before — bit-identical HLO)."""
+        return self._configs.get("CompileConfig")
 
     @property
     def telemetry_config(self) -> Optional[TelemetryConfig]:

@@ -323,6 +323,12 @@ class Telemetry:
         inst = self.registry.get(name)
         return inst.value if inst is not None else 0.0
 
+    def _counter_or_none(self, name: str) -> Optional[float]:
+        """Counter value, or None when nothing ever registered it (the
+        "feature absent -> field null" contract)."""
+        inst = self.registry.get(name)
+        return inst.value if inst is not None else None
+
     def _delta(self, name: str) -> float:
         """Per-window delta of a cumulative counter (vs the last record)."""
         now = self._counter_value(name)
@@ -426,6 +432,14 @@ class Telemetry:
             compiles = recompiles = 0
             compile_time = 0.0
 
+        # persistent compile cache (ISSUE 6): cumulative AOT hit/miss
+        # counts + reclaimed compile seconds.  The counters exist only
+        # when a CompileCache registered them (a CompileConfig run) —
+        # absent, the fields ride as nulls.
+        cc_hits = self._counter_or_none("compile_cache/hits_total")
+        cc_misses = self._counter_or_none("compile_cache/misses_total")
+        cc_saved = self._counter_or_none("compile_cache/saved_s_total")
+
         # step-time attribution (ISSUE 4): per-window MFU/roofline gauges
         # + goodput buckets, derived from the deltas computed above — one
         # code path for all four facade step APIs
@@ -482,6 +496,9 @@ class Telemetry:
             compiles_total=compiles,
             recompiles=recompiles,
             compile_time_s=compile_time,
+            compile_cache_hits=cc_hits,
+            compile_cache_misses=cc_misses,
+            compile_cache_saved_s=cc_saved,
             hbm_bytes_in_use=(hbm or {}).get("bytes_in_use"),
             hbm_peak_bytes=(hbm or {}).get("peak_bytes_in_use"),
             hbm_bytes_limit=(hbm or {}).get("bytes_limit"),

@@ -97,10 +97,22 @@ STEP_EVENT_FIELDS: Dict[str, tuple] = {
     # the window wall time (ts delta to the previous record)
     "goodput_productive_s": (False, "nullable_number"),
     "goodput_compile_s": (False, "nullable_number"),
+    # compile split (ISSUE 6; additive): the compile+recompile seconds
+    # partitioned into fresh XLA backend compiles vs AOT-compile-cache
+    # warm-start loads (fresh + cached == compile + recompile within
+    # rounding); null without an AttributionConfig
+    "goodput_compile_fresh_s": (False, "nullable_number"),
+    "goodput_compile_cached_s": (False, "nullable_number"),
     "goodput_recompile_s": (False, "nullable_number"),
     "goodput_loader_s": (False, "nullable_number"),
     "goodput_checkpoint_s": (False, "nullable_number"),
     "goodput_halt_s": (False, "nullable_number"),
+    # persistent compile cache (ISSUE 6; additive, null without a
+    # CompileConfig): cumulative AOT hit/miss counts and the original
+    # compile seconds the cache's hits reclaimed this run
+    "compile_cache_hits": (False, "nullable_number"),
+    "compile_cache_misses": (False, "nullable_number"),
+    "compile_cache_saved_s": (False, "nullable_number"),
     # fleet view (ISSUE 5; keys absent without a FleetConfig, null between
     # exchange windows): cross-host skew aggregates derived from the
     # in-band per-host signal exchange — hosts/window identify the
@@ -243,10 +255,15 @@ def build_step_event(
     bound: Optional[str] = None,
     goodput_productive_s: Optional[float] = None,
     goodput_compile_s: Optional[float] = None,
+    goodput_compile_fresh_s: Optional[float] = None,
+    goodput_compile_cached_s: Optional[float] = None,
     goodput_recompile_s: Optional[float] = None,
     goodput_loader_s: Optional[float] = None,
     goodput_checkpoint_s: Optional[float] = None,
     goodput_halt_s: Optional[float] = None,
+    compile_cache_hits: Optional[int] = None,
+    compile_cache_misses: Optional[int] = None,
+    compile_cache_saved_s: Optional[float] = None,
     hbm_bytes_in_use: Optional[int] = None,
     hbm_peak_bytes: Optional[int] = None,
     hbm_bytes_limit: Optional[int] = None,
@@ -306,10 +323,20 @@ def build_step_event(
         # contract: buckets sum to wall time within 1%)
         "goodput_productive_s": _round(goodput_productive_s),
         "goodput_compile_s": _round(goodput_compile_s),
+        "goodput_compile_fresh_s": _round(goodput_compile_fresh_s),
+        "goodput_compile_cached_s": _round(goodput_compile_cached_s),
         "goodput_recompile_s": _round(goodput_recompile_s),
         "goodput_loader_s": _round(goodput_loader_s),
         "goodput_checkpoint_s": _round(goodput_checkpoint_s),
         "goodput_halt_s": _round(goodput_halt_s),
+        "compile_cache_hits": (
+            None if compile_cache_hits is None else int(compile_cache_hits)
+        ),
+        "compile_cache_misses": (
+            None if compile_cache_misses is None
+            else int(compile_cache_misses)
+        ),
+        "compile_cache_saved_s": _round(compile_cache_saved_s),
         "hbm_bytes_in_use": hbm_bytes_in_use,
         "hbm_peak_bytes": hbm_peak_bytes,
         "hbm_bytes_limit": hbm_bytes_limit,

@@ -1,0 +1,263 @@
+"""Autotuner tests (ISSUE 6): trial-spec identity, bound-classification
+pruning, greedy-search convergence on synthetic measure functions, trial
+budget/failure handling, and ledger winner provenance.
+
+The search layer is deliberately jax-free (the driver orchestrates
+subprocess trials), so these tests run pure-host and deterministic.
+"""
+
+import json
+import math
+
+import pytest
+
+from stoke_tpu.autotune import (
+    BOUND_KNOB_KINDS,
+    KNOB_KIND,
+    SearchOutcome,
+    TrialResult,
+    TrialSpec,
+    greedy_search,
+    knobs_for_bound,
+    load_ledger,
+    persist_winner,
+    read_winner,
+    winner_metric,
+)
+
+pytestmark = pytest.mark.autotune
+
+
+# --------------------------------------------------------------------------- #
+# trial specs
+# --------------------------------------------------------------------------- #
+
+
+def test_config_key_identity_and_determinism():
+    assert TrialSpec().config_key() == "baseline"
+    a = TrialSpec(batch=256, steps_per_dispatch=25)
+    b = TrialSpec(batch=256, steps_per_dispatch=25)
+    assert a.config_key() == b.config_key()
+    assert "batch=256" in a.config_key()
+    assert a.config_key() != TrialSpec(batch=512).config_key()
+    # flags participate; empty flags do not
+    assert TrialSpec(xla_flags="--x=1").config_key() != "baseline"
+    assert TrialSpec(xla_flags="").config_key() == "baseline"
+
+
+def test_spec_roundtrip_and_with_knob():
+    spec = TrialSpec(batch=128, comm_dtype="int8")
+    assert TrialSpec.from_dict(spec.to_dict()) == spec
+    # unknown keys are dropped, not fatal (forward-compatible ledger reads)
+    assert TrialSpec.from_dict({"batch": 64, "novel_knob": 1}).batch == 64
+    bumped = spec.with_knob("steps_per_dispatch", 50)
+    assert bumped.steps_per_dispatch == 50 and spec.steps_per_dispatch is None
+
+
+# --------------------------------------------------------------------------- #
+# pruning honors the bound classification
+# --------------------------------------------------------------------------- #
+
+FULL_SPACE = {
+    "xla_flags": ["", "--a"],
+    "batch": [128, 256],
+    "steps_per_dispatch": [10, 25],
+    "comm_dtype": ["bf16"],
+}
+
+
+def test_memory_bound_prunes_compute_flags():
+    """The ISSUE 6 contract: memory-bound => don't sweep compute flags."""
+    knobs = knobs_for_bound("memory", FULL_SPACE)
+    assert "xla_flags" not in knobs
+    assert "batch" in knobs and "steps_per_dispatch" in knobs
+
+
+def test_host_bound_prioritizes_dispatch_amortization():
+    knobs = knobs_for_bound("host", FULL_SPACE)
+    assert knobs[0] == "steps_per_dispatch"
+    # host-bound sweeps everything, just reordered
+    assert set(knobs) == set(FULL_SPACE)
+
+
+def test_comm_bound_keeps_wire_format_first():
+    knobs = knobs_for_bound("comm", FULL_SPACE)
+    assert knobs[0] == "comm_dtype"
+    assert "batch" not in knobs  # memory knobs cannot relieve a comm bound
+
+
+def test_unknown_or_missing_bound_never_empties_the_sweep():
+    assert set(knobs_for_bound(None, FULL_SPACE)) == set(FULL_SPACE)
+    assert set(knobs_for_bound("weird", FULL_SPACE)) == set(FULL_SPACE)
+    # every knob kind appears in every fallback ordering
+    assert set(KNOB_KIND.values()) <= set(BOUND_KNOB_KINDS[None])
+
+
+# --------------------------------------------------------------------------- #
+# greedy search on synthetic measure functions
+# --------------------------------------------------------------------------- #
+
+
+def _mfu_measure(optimum_batch=512, bound="compute"):
+    """Synthetic measure: MFU peaks at ``optimum_batch``; seg helps a
+    little.  Deterministic, records every call."""
+    calls = []
+
+    def measure(spec: TrialSpec) -> TrialResult:
+        calls.append(spec.config_key())
+        batch = spec.batch or 128
+        seg = spec.steps_per_dispatch or 10
+        mfu = 0.5 - abs(batch - optimum_batch) / 2048 + seg / 1000.0
+        return TrialResult(
+            spec, value=batch * 10.0, mfu=mfu, goodput_fraction=0.9,
+            bound=bound,
+        )
+
+    measure.calls = calls
+    return measure
+
+
+def test_search_converges_on_synthetic_optimum():
+    space = {
+        "batch": [128, 256, 512, 1024],
+        "steps_per_dispatch": [10, 25, 50],
+    }
+    measure = _mfu_measure(optimum_batch=512)
+    out = greedy_search(measure, TrialSpec(), space, max_trials=16)
+    assert out.best.spec.batch == 512
+    assert out.best.spec.steps_per_dispatch == 50
+    assert out.trials == len(out.history) <= 16
+    # coordinate ascent carries the best spec forward: the winning score
+    # is the max of everything measured
+    assert out.best.score() == max(r.score() for r in out.history)
+
+
+def test_search_never_remeasures_a_config():
+    space = {"batch": [128, 128, 256], "steps_per_dispatch": [10]}
+    measure = _mfu_measure()
+    out = greedy_search(measure, TrialSpec(), space, max_trials=16)
+    assert len(measure.calls) == len(set(measure.calls))
+
+
+def test_search_respects_trial_budget():
+    space = {"batch": list(range(100, 2000, 100))}
+    measure = _mfu_measure()
+    out = greedy_search(measure, TrialSpec(), space, max_trials=4)
+    assert out.trials == 4
+    assert len(measure.calls) == 4
+
+
+def test_search_prunes_by_baseline_bound():
+    """A memory-bound baseline must not burn budget on compute flags."""
+    space = {"xla_flags": ["", "--a", "--b"], "batch": [128, 256]}
+    measure = _mfu_measure(bound="memory")
+    out = greedy_search(measure, TrialSpec(), space, max_trials=16)
+    assert "xla_flags" in out.pruned_knobs
+    assert all("xla_flags=" not in k for k in measure.calls)
+
+
+def test_failed_trials_recorded_but_never_win():
+    def measure(spec: TrialSpec) -> TrialResult:
+        if spec.batch == 256:
+            return TrialResult(spec, ok=False, error="OOM")
+        return TrialResult(spec, value=float(spec.batch or 1), bound=None)
+
+    out = greedy_search(
+        measure, TrialSpec(), {"batch": [64, 256, 128]}, max_trials=16
+    )
+    assert out.best.spec.batch == 128
+    failed = [r for r in out.history if not r.ok]
+    assert len(failed) == 1 and failed[0].error == "OOM"
+    assert failed[0].score() == -math.inf
+
+
+def test_score_prefers_mfu_times_goodput_over_raw_value():
+    high_tp = TrialResult(TrialSpec(), value=9999.0, mfu=0.2,
+                          goodput_fraction=0.5)
+    high_mfu = TrialResult(TrialSpec(batch=1), value=1.0, mfu=0.4,
+                           goodput_fraction=0.9)
+    assert high_mfu.score() > high_tp.score()
+    # without attribution data, throughput decides
+    assert TrialResult(TrialSpec(), value=10.0).score() == 10.0
+
+
+# --------------------------------------------------------------------------- #
+# ledger winner provenance
+# --------------------------------------------------------------------------- #
+
+
+def test_persist_and_read_winner_provenance(tmp_path):
+    ledger = str(tmp_path / "BENCH_RESULTS.json")
+    best = TrialResult(
+        TrialSpec(batch=256, steps_per_dispatch=25, xla_flags="--x=1"),
+        value=9500.0, mfu=0.41, goodput_fraction=0.93, bound="compute",
+    )
+    outcome = SearchOutcome(
+        best, history=[best], pruned_knobs=["comm_dtype"], trials=7
+    )
+    rec = persist_winner(
+        ledger, "cifar10_resnet50_bf16_train_throughput", outcome,
+        backend="tpu",
+    )
+    back = read_winner(ledger, "cifar10_resnet50_bf16_train_throughput")
+    assert back == rec
+    # full provenance: config key, flags, measured MFU, trial count
+    assert back["config_key"] == "xla_flags=--x=1|batch=256|steps_per_dispatch=25"
+    assert back["spec"]["xla_flags"] == "--x=1"
+    assert back["mfu"] == pytest.approx(0.41)
+    assert back["goodput_fraction"] == pytest.approx(0.93)
+    assert back["trials"] == 7
+    assert back["pruned_knobs"] == ["comm_dtype"]
+    assert back["backend"] == "tpu" and back["date"]
+    # the replay spec round-trips into a TrialSpec
+    assert TrialSpec.from_dict(back["spec"]).config_key() == back["config_key"]
+
+
+def test_persist_winner_merges_with_existing_ledger(tmp_path):
+    ledger = str(tmp_path / "BENCH_RESULTS.json")
+    with open(ledger, "w") as f:
+        json.dump({"other_metric": {"value": 1.0}}, f)
+    outcome = SearchOutcome(TrialResult(TrialSpec(), value=5.0), trials=1)
+    persist_winner(ledger, "m", outcome)
+    data = load_ledger(ledger)
+    assert data["other_metric"] == {"value": 1.0}
+    assert winner_metric("m") in data
+
+
+def test_read_winner_absent_is_none(tmp_path):
+    assert read_winner(str(tmp_path / "nope.json"), "m") is None
+
+
+# --------------------------------------------------------------------------- #
+# end-to-end driver smoke (subprocess trials; full-suite tier only)
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.slow
+def test_autotune_smoke_end_to_end(tmp_path):
+    """The ISSUE 6 acceptance flow: ``scripts/autotune.py --smoke``
+    completes a >= 4-trial sweep and persists a winner in the ledger
+    with provenance."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ledger = str(tmp_path / "BENCH_RESULTS.json")
+    out = subprocess.run(
+        [
+            sys.executable, os.path.join(repo, "scripts", "autotune.py"),
+            "--smoke", "--ledger", ledger,
+        ],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "PYTHONPATH": repo, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    assert summary["autotune"] == "ok"
+    assert summary["trials"] >= 4
+    winner = read_winner(ledger, summary["metric"])
+    assert winner is not None
+    assert winner["config_key"] and winner["spec"] is not None
+    assert winner["trials"] == summary["trials"]
+    assert winner["mfu"] is not None  # attribution rode every trial
