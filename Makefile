@@ -7,7 +7,7 @@
 CPU_ENV = env PYTHONPATH=$(CURDIR) JAX_PLATFORMS=cpu
 MESH_ENV = $(CPU_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-full test-fast test-telemetry test-collectives test-health test-attribution test-fleet test-autotune autotune-smoke dryrun bench-smoke telemetry-smoke tpu-probe
+.PHONY: test test-full test-fast test-telemetry test-collectives test-health test-attribution test-fleet test-autotune test-resilience autotune-smoke dryrun bench-smoke telemetry-smoke tpu-probe
 
 test:            ## default tier (excludes @slow compile-heavy equivalence tests)
 	$(MESH_ENV) python -m pytest tests/ -x -q
@@ -38,6 +38,9 @@ test-fleet:      ## fleet-observability tests only (skew aggregation/stragglers/
 
 test-autotune:   ## autotuner + compile-cache tests only (search/pruning/ledger/warm starts)
 	$(MESH_ENV) python -m pytest tests/ -x -q -m autotune
+
+test-resilience: ## pod-scale resilience tests only (preemption save/resume/quarantine/chaos/supervisor)
+	$(MESH_ENV) python -m pytest tests/ -x -q -m resilience
 
 autotune-smoke:  ## CPU-safe autotune sweep smoke (>= 4 subprocess trials, never touches the tunnel)
 	$(CPU_ENV) python scripts/autotune.py --smoke --no-persist

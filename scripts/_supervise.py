@@ -38,6 +38,12 @@ import time
 #: because this module must never import jax-importing packages)
 HEALTH_WATCHDOG_EXIT_CODE = 113
 
+#: exit code of a worker that was preempted and drained cleanly (emergency
+#: checkpoint written) — kept in sync with stoke_tpu/resilience.py
+#: PREEMPTION_EXIT_CODE.  Distinct from 113: the supervisor can tell
+#: "drained, resume from the emergency tag" from "hung and self-killed".
+PREEMPTION_EXIT_CODE = 114
+
 #: env var the flight recorder appends bundle paths to (kept in sync with
 #: stoke_tpu/telemetry/recorder.py BUNDLE_FILE_ENV)
 BUNDLE_FILE_ENV = "STOKE_HEALTH_BUNDLE_FILE"
@@ -144,6 +150,23 @@ def supervise(
                             "completed within its timeout)"
                         ),
                         "watchdog_exit_code": HEALTH_WATCHDOG_EXIT_CODE,
+                        "bundles": _read_bundles(bundle_file),
+                    }))
+                elif proc.returncode == PREEMPTION_EXIT_CODE:
+                    # preempted and drained cleanly (ISSUE 7): the worker
+                    # wrote an emergency checkpoint and exited resumably —
+                    # scripts/run_resilient.py restarts these; here we
+                    # surface the outcome so a bare supervise caller knows
+                    # the run is resumable, not broken
+                    print(json.dumps({
+                        "error": (
+                            "worker preempted and drained cleanly "
+                            f"(exit {PREEMPTION_EXIT_CODE}: emergency "
+                            "checkpoint written; resumable via "
+                            "Stoke.resume() / scripts/run_resilient.py)"
+                        ),
+                        "preemption_exit_code": PREEMPTION_EXIT_CODE,
+                        "resumable": True,
                         "bundles": _read_bundles(bundle_file),
                     }))
                 return proc.returncode

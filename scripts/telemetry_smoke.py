@@ -5,8 +5,10 @@ telemetry-smoke``): a JSONL step record (schema-validated on read-back)
 carrying the health-sentinel fields, a Prometheus exposition file, a TB
 event stream readable by the native frame parser, and — since ISSUE 3 — a
 forced post-mortem bundle with the flight-recorder ring, all-thread
-stacks, and run config.  Prints the step record and a one-line verdict;
-exit 0 only when everything round-trips.
+stacks, and run config.  Since ISSUE 6, one compile-cache warm start;
+since ISSUE 7, one preemption → emergency-save → resume cycle (manifest
+written, counters restored).  Prints the step record and a one-line
+verdict; exit 0 only when everything round-trips.
 """
 
 from __future__ import annotations
@@ -108,6 +110,54 @@ def main() -> int:
         )
     )
 
+    # pod-scale resilience (ISSUE 7): one preemption -> emergency-save ->
+    # resume cycle end-to-end — the in-process variant (exit_on_preempt
+    # False raises PreemptedError instead of exiting), proving the
+    # manifest-verified resume restores step counters AND the
+    # out-of-payload state (rng/EMA) bit-identically
+    from stoke_tpu import PreemptedError, ResilienceConfig
+
+    rz_root = os.path.join(out_dir, "resilience")
+    rz_cfg = ResilienceConfig(save_path=rz_root, exit_on_preempt=False)
+
+    def _rz_run():
+        return Stoke(
+            model=lambda p, x: x @ p["w"],
+            optimizer=StokeOptimizer(
+                optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.1}
+            ),
+            loss=lambda o, y: ((o - y) ** 2).mean(),
+            params={"w": np.full((8, 4), 2.0, np.float32)},
+            batch_size_per_device=16,
+            configs=[rz_cfg],
+            verbose=False,
+        )
+
+    rz_first = _rz_run()
+    rz_first.train_step(x, (y,))
+    rz_first.resilience.request_preemption("smoke")
+    preempted = False
+    try:
+        rz_first.train_step(x, (y,))  # boundary honors the notice
+    except PreemptedError:
+        preempted = True
+    rz_resumed = _rz_run()
+    resumed_ok = rz_resumed.resume()
+    rz_resumed.train_step(x, (y,))  # the step the preempted run never ran
+    resilience_ok = (
+        preempted
+        and resumed_ok
+        and rz_resumed.optimizer_steps == 3
+        and (rz_resumed.resilience_summary or {}).get("resumed_step") == 2
+        and os.path.exists(
+            os.path.join(
+                rz_root, "stoke-emergency-backward-step-2", "manifest.json"
+            )
+        )
+    )
+    rz_first.close_telemetry()
+    rz_resumed.close_telemetry()
+
     records = read_step_events(os.path.join(out_dir, "steps.jsonl"))
     print(json.dumps(records[-1], sort_keys=True))
     rec = records[-1]
@@ -177,6 +227,7 @@ def main() -> int:
         and bundle_ok
         and {"sentinels", "step_event"} <= ring_kinds
         and compile_cache_ok
+        and resilience_ok
     )
     print(json.dumps({
         "telemetry_smoke": "ok" if ok else "FAILED",
@@ -195,6 +246,8 @@ def main() -> int:
         "fleet_skew_class": rec.get("fleet/skew_class"),
         "compile_cache_cold": cc_cold.compile_cache.stats(),
         "compile_cache_warm": cc_warm.compile_cache.stats(),
+        "resilience_cycle": "ok" if resilience_ok else "FAILED",
+        "resilience_resumed": rz_resumed.resilience_summary,
     }))
     return 0 if ok else 1
 

@@ -33,6 +33,7 @@ from stoke_tpu.configs import (
     PrecisionConfig,
     PrecisionOptions,
     ProfilerConfig,
+    ResilienceConfig,
     SDDPConfig,
     TelemetryConfig,
     TensorboardConfig,
@@ -52,6 +53,7 @@ from stoke_tpu.engine import (
     ModelAdapter,
 )
 from stoke_tpu.facade import Stoke
+from stoke_tpu.resilience import PreemptedError
 from stoke_tpu.status import StokeStatus, StokeValidationError
 from stoke_tpu.telemetry.health import HealthHaltError
 from stoke_tpu.utils import force_cpu, init_module
@@ -63,6 +65,7 @@ __all__ = [
     "StokeStatus",
     "StokeValidationError",
     "HealthHaltError",
+    "PreemptedError",
     "force_cpu",
     "init_module",
     "StokeOptimizer",
@@ -100,6 +103,7 @@ __all__ = [
     "ActivationCheckpointingConfig",
     "CheckpointConfig",
     "ProfilerConfig",
+    "ResilienceConfig",
     "TelemetryConfig",
     "TensorboardConfig",
     # adapters

@@ -923,6 +923,88 @@ class FleetConfig:
 
 
 @dataclass
+class ResilienceConfig:
+    """Pod-scale resilience (ISSUE 7 tentpole): preemption-aware emergency
+    checkpointing, integrity-verified auto-resume with quarantine, and the
+    deterministic fault-injection harness.
+
+    No reference equivalent (SURVEY.md §5: the reference's failure story is
+    "crash = job death").  Millions-of-users scale means preemptible fleets
+    and multi-day jobs: MLPerf-on-TPU-pods attributes most lost pod scaling
+    to host-level disruption (arXiv:1909.09756), and the sharded per-host
+    state of the ZeRO lineage (arXiv:2004.13336) makes "just restart it"
+    a correctness problem a resume path must own.  Default OFF — without
+    this config the step paths, signal dispositions, and checkpoint layout
+    are untouched (bit-identical HLO, dispatch-count equal; the
+    established guarantee).
+
+    With it on:
+
+    1. The preemption-notice signals set a flag; the facade finishes the
+       in-flight optimizer step, drains async checkpoint threads, writes a
+       synchronous **emergency checkpoint** (step counters + rng + loss-EMA
+       + error-feedback residual in the extras) under ``save_path``, and
+       exits with the distinct resumable ``exit_code``.
+    2. Every checkpoint the facade writes additionally carries a
+       ``manifest.json`` of per-file sha256 digests; ``Stoke.resume()``
+       restores the newest tag that VERIFIES, quarantining (never
+       deleting) corrupt or partial tags.
+    3. ``resilience/*`` counters (preemptions, emergency saves, restarts,
+       resumed/lost steps, quarantined tags) ride the telemetry registry
+       and JSONL step events.
+    4. The ``STOKE_CHAOS`` env var (or ``chaos`` here; config wins) arms
+       the fault injector: ``kill_at_step=K`` (+ ``kill_mode=sigterm|
+       sigkill|exception``), ``corrupt_save=N``, ``wedge_at_step=K`` (+
+       ``wedge_s=S``).
+
+    Attributes:
+        save_path: emergency-checkpoint root directory (status-validated
+            writable; also where ``Stoke.resume()`` looks first).
+        save_name: tag name of emergency checkpoints (kept distinct from
+            ``CheckpointConfig.auto_name`` so the two cadences never prune
+            each other).
+        preempt_signals: signal names treated as preemption notices.  With
+            resilience on these mean "drain and save" — the flight
+            recorder's dump-and-die SIGTERM disposition is superseded (the
+            emergency path writes a better corpse: a loadable checkpoint
+            plus a post-mortem bundle when a ``HealthConfig`` is present).
+        exit_code: process exit code after a successful drain (must be
+            1..255 and differ from the health watchdog's 113 so
+            supervisors can classify drained-vs-hung; default 114).
+            Only the default is in the stock supervisor's resumable set —
+            a custom code must be paired with ``run_resilient.py
+            --extra-resumable <code>`` or the supervisor classifies the
+            clean drain as fatal and stops instead of restarting.
+        exit_on_preempt: exit the process after the emergency save (the
+            supervised-restart contract).  False raises
+            :class:`~stoke_tpu.resilience.PreemptedError` instead —
+            in-process drivers (tests, smoke) resume without a restart.
+        manifest: write per-file digest manifests into every checkpoint
+            this facade saves (emergency AND periodic/manual).
+        verify_on_resume: validate digests during ``Stoke.resume()``
+            discovery (manifest-less legacy tags stay acceptable).
+        quarantine: move invalid tags to ``<root>/quarantine/`` during
+            resume discovery instead of leaving them to shadow older
+            valid tags.  Never deletes.
+        max_to_keep: newest emergency tags kept under ``save_path``
+            (pruned with the same in-flight-tag guard as every save).
+        chaos: fault-injection spec (overrides the ``STOKE_CHAOS`` env
+            var; None reads the env).  Parse errors are status errors.
+    """
+
+    save_path: str = "resilience_ckpts"
+    save_name: str = "emergency"
+    preempt_signals: Tuple[str, ...] = ("SIGTERM",)
+    exit_code: int = 114
+    exit_on_preempt: bool = True
+    manifest: bool = True
+    verify_on_resume: bool = True
+    quarantine: bool = True
+    max_to_keep: Optional[int] = 3
+    chaos: Optional[str] = None
+
+
+@dataclass
 class CompileConfig:
     """Persistent compilation cache + AOT-lowered step programs (ISSUE 6
     tentpole).
@@ -1065,6 +1147,7 @@ ALL_CONFIG_CLASSES: Tuple[type, ...] = (
     FleetConfig,
     HealthConfig,
     ProfilerConfig,
+    ResilienceConfig,
     TelemetryConfig,
     TensorboardConfig,
 )

@@ -471,6 +471,12 @@ class StepEngine:
         # compile), then dispatches through the jitted fn as always.
         # None -> zero bookkeeping, dispatch untouched.
         self._compile_cache = None
+        # fault injector (ISSUE 7): assigned by the facade when a
+        # ResilienceConfig arms a chaos spec.  _aot_call (the funnel every
+        # dispatch site resolves its callable through) gives it a
+        # pre-dispatch hook — host-side only, the compiled programs are
+        # untouched.  None -> dispatch untouched.
+        self._chaos = None
         # shardings, resolved lazily once variables are known
         self._var_shardings = None
         self._grad_shardings = None
@@ -769,7 +775,14 @@ class StepEngine:
         hit (the persistent XLA cache serves the impending backend
         compile) or records the cold cost — and every later dispatch is
         ``fn`` untouched.  Dispatch semantics (donation, async, numerics)
-        are ALWAYS plain ``jax.jit``."""
+        are ALWAYS plain ``jax.jit``.
+
+        Also the fault injector's pre-dispatch hook (ISSUE 7): with a
+        chaos spec armed, ``wedge_at_step`` stalls the first dispatch after
+        its step here — the deterministic stand-in for a wedged collective
+        the hang watchdog exists to catch."""
+        if self._chaos is not None:
+            self._chaos.on_dispatch(program)
         cache = self._compile_cache
         if cache is None:
             return fn

@@ -176,6 +176,7 @@ def save_checkpoint(
     config: CheckpointConfig,
     backward_step: int,
     grad_buf: Any = None,
+    manifest: bool = False,
 ) -> str:
     """Write one logical checkpoint; returns the tag directory path.
 
@@ -187,6 +188,13 @@ def save_checkpoint(
     (the partial accumulation window) is saved
     too so a mid-window resume loses no gradient mass — the reference cannot
     do this (torch ``.grad`` is not in ``state_dict``).
+
+    ``manifest=True`` (ISSUE 7): after ``meta.json``, the writer rank adds
+    a ``manifest.json`` of per-file sha256 digests over the completed tag —
+    the integrity record ``Stoke.resume()`` validates against before
+    trusting a checkpoint (corrupt/partial tags are quarantined, never
+    loaded).  Written LAST on both the sync and async paths, so a tag with
+    a manifest is a tag whose write finished.
     """
     root = make_folder(path)
     tag = checkpoint_tag(name, backward_step)
@@ -220,6 +228,14 @@ def save_checkpoint(
         between them."""
         if jax.process_index() != writer:
             return
+        # extras BEFORE meta.json: meta is the tag's "loadable" marker
+        # (verify_checkpoint treats a meta-less tag as a partial write), so
+        # a hard kill between the two files must leave the tag UNloadable —
+        # the reverse order would let resume silently restore without the
+        # rng/EMA/EF-residual extras and break bit-identical resumption
+        if extras:
+            with open(os.path.join(tag_dir, "extras.pkl"), "wb") as f:
+                pickle.dump(extras, f)
         meta = {
             "format": fmt_value,
             "counters": counters,
@@ -228,9 +244,16 @@ def save_checkpoint(
         }
         with open(os.path.join(tag_dir, "meta.json"), "w") as f:
             json.dump(meta, f, indent=2, default=str)
-        if extras:
-            with open(os.path.join(tag_dir, "extras.pkl"), "wb") as f:
-                pickle.dump(extras, f)
+        if manifest:
+            # integrity digests over the finished tag (ISSUE 7) — shared
+            # by the sync and async paths like the meta schema above, so
+            # the manifest can never claim files a crashed write lost
+            from stoke_tpu.resilience import write_manifest
+
+            write_manifest(
+                tag_dir,
+                extra={"backward_step": backward_step, "name": name},
+            )
 
     def _write_meta():
         if jax.process_index() == writer:
@@ -342,20 +365,28 @@ def wait_for_saves() -> None:
     checkpoint is loadable".  The barrier runs before errors are raised so
     a failing process never strands its peers mid-barrier.
 
-    Raises the first background-save failure (disk full, serialization
-    error, ...) rather than silently dropping it — a checkpoint that was
-    never written must not look saved (ADVICE r1: io_ops medium)."""
+    Raises on background-save failure (disk full, serialization error, ...)
+    rather than silently dropping it — a checkpoint that was never written
+    must not look saved (ADVICE r1: io_ops medium).  EVERY failed tag dir
+    is named in the message (ISSUE 7 satellite: an operator deciding which
+    checkpoints are trustworthy needs the full casualty list, not the first
+    failure with "+2 more"); the first underlying exception chains as the
+    cause and the rest are summarized inline."""
     while _ASYNC_SAVES:
         _ASYNC_SAVES.pop().join()
     _barrier()
     if _ASYNC_ERRORS:
-        tag_dir, err = _ASYNC_ERRORS[0]
-        rest = len(_ASYNC_ERRORS) - 1
+        failures = list(_ASYNC_ERRORS)
         _ASYNC_ERRORS.clear()
+        _, first_err = failures[0]
+        detail = "; ".join(
+            f"{tag_dir} ({type(err).__name__}: {err})"
+            for tag_dir, err in failures
+        )
         raise RuntimeError(
-            f"Stoke -- async checkpoint save to {tag_dir} failed"
-            + (f" (+{rest} more)" if rest else "")
-        ) from err
+            f"Stoke -- {len(failures)} async checkpoint save"
+            f"{'s' if len(failures) > 1 else ''} failed: {detail}"
+        ) from first_err
 
 
 def _prune_old(root: str, name: str, max_to_keep: Optional[int]) -> None:

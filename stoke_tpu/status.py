@@ -51,6 +51,7 @@ from stoke_tpu.configs import (
     PrecisionConfig,
     PrecisionOptions,
     ProfilerConfig,
+    ResilienceConfig,
     SDDPConfig,
     ShardingOptions,
     TelemetryConfig,
@@ -605,6 +606,73 @@ class StokeStatus:
                 )
             return False
 
+        def _resilience_invalid(s):
+            """Resilience legality (ISSUE 7): the emergency-save root must
+            be writable on EVERY process (sharded emergency saves write
+            from all ranks), the resumable exit code must be expressible
+            AND distinct from the health watchdog's (supervisors classify
+            drained-vs-hung on exactly that difference), the preemption
+            signals must exist on this platform, and a chaos spec — config
+            field or ``STOKE_CHAOS`` env — must parse (a typo'd plan
+            silently injecting nothing would fake a green chaos test)."""
+            cfg = self._configs.get("ResilienceConfig")
+            if cfg is None:
+                return False
+            from stoke_tpu.resilience import (
+                CHAOS_ENV,
+                _WATCHDOG_EXIT_CODE,
+                parse_chaos,
+            )
+
+            if not (0 < cfg.exit_code < 256):
+                return (
+                    f"ResilienceConfig.exit_code must be 1..255 (a process "
+                    f"exit status), got {cfg.exit_code}"
+                )
+            if cfg.exit_code == _WATCHDOG_EXIT_CODE:
+                return (
+                    f"ResilienceConfig.exit_code {cfg.exit_code} collides "
+                    f"with the health watchdog's exit code — supervisors "
+                    f"classify 'drained cleanly' vs 'hung and self-killed' "
+                    f"on that difference; pick another code"
+                )
+            if not cfg.preempt_signals:
+                return (
+                    "ResilienceConfig.preempt_signals is empty — the "
+                    "preemption handler would never arm; name at least one "
+                    "signal or drop the config"
+                )
+            import signal as _signal
+
+            for name in cfg.preempt_signals:
+                if not isinstance(name, str) or getattr(
+                    _signal, name, None
+                ) is None:
+                    return (
+                        f"ResilienceConfig.preempt_signals names unknown "
+                        f"signal {name!r} (e.g. 'SIGTERM', 'SIGUSR1')"
+                    )
+            if cfg.max_to_keep is not None and cfg.max_to_keep < 1:
+                return (
+                    f"ResilienceConfig.max_to_keep must be >= 1 or None, "
+                    f"got {cfg.max_to_keep}"
+                )
+            spec = (
+                cfg.chaos if cfg.chaos is not None
+                else os.environ.get(CHAOS_ENV)
+            )
+            try:
+                parse_chaos(spec)
+            except ValueError as e:
+                return str(e)
+            err = _probe_writable(cfg.save_path)
+            if err is not None:
+                return (
+                    f"ResilienceConfig.save_path {cfg.save_path!r} is not "
+                    f"writable: {err}"
+                )
+            return False
+
         def _compile_invalid(s):
             """Compile-cache legality (ISSUE 6): the cache directory must
             be writable on EVERY process (each serializes its own step
@@ -767,6 +835,10 @@ class StokeStatus:
             (
                 _fleet_invalid,
                 "FleetConfig is invalid for this combination",
+            ),
+            (
+                _resilience_invalid,
+                "ResilienceConfig is invalid",
             ),
             (
                 _compile_invalid,
@@ -1003,6 +1075,13 @@ class StokeStatus:
         opt-in; without it no cross-host exchange ever runs and the step
         paths are bit-identical to pre-ISSUE-5)."""
         return self._configs.get("FleetConfig")
+
+    @property
+    def resilience_config(self) -> Optional[ResilienceConfig]:
+        """None unless explicitly supplied (pod-scale resilience is
+        opt-in; without it the step paths, signal dispositions, and
+        checkpoint layout are bit-identical to pre-ISSUE-7)."""
+        return self._configs.get("ResilienceConfig")
 
     @property
     def compile_config(self) -> Optional[CompileConfig]:

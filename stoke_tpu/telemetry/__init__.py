@@ -182,6 +182,10 @@ class Telemetry:
         # FleetConfig is supplied; None keeps record_step free of any
         # cross-host exchange entirely
         self.fleet = None
+        # resilience monitor (ISSUE 7) — assigned by the facade when a
+        # ResilienceConfig is supplied; None keeps the resilience/* keys
+        # out of every step event entirely
+        self.resilience = None
         # cross-process sync timings (Stoke.barrier / checkpoint
         # sync_global_devices) land in this registry even when no
         # TelemetryConfig drives sinks — the wall-clock breakdown and
@@ -467,6 +471,13 @@ class Telemetry:
                 comm_bytes_onwire=comm_wire,
             )
 
+        # resilience counters (ISSUE 7): cumulative preemption/restart/
+        # quarantine accounting rides every record when a monitor is
+        # attached — pure registry reads, no device or IO work
+        resilience_fields: Optional[dict] = None
+        if self.resilience is not None:
+            resilience_fields = self.resilience.event_fields()
+
         hbm = hbm_stats() if self.config.track_hbm else None
         record = build_step_event(
             ts=now,
@@ -503,6 +514,7 @@ class Telemetry:
             hbm_peak_bytes=(hbm or {}).get("peak_bytes_in_use"),
             hbm_bytes_limit=(hbm or {}).get("bytes_limit"),
             fleet=fleet_fields,
+            resilience=resilience_fields,
             **attr_fields,
         )
         snapshot = self.registry.snapshot()

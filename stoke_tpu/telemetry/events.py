@@ -135,6 +135,19 @@ STEP_EVENT_FIELDS: Dict[str, tuple] = {
     "fleet/skew_class": (False, "nullable_string"),
     "fleet/barrier_wait_s": (False, "nullable_number"),
     "fleet/barrier_charged_host": (False, "nullable_number"),
+    # resilience (ISSUE 7; keys absent without a ResilienceConfig):
+    # cumulative preemption notices honored, emergency checkpoints
+    # written, corrupt tags quarantined at resume; restarts is the
+    # supervisor attempt number this process is (0 = first run);
+    # resumed_step the optimizer step this run restored from (null until
+    # a resume happens), lost_steps the steps a newer-but-invalid tag
+    # had recorded beyond the resumed one
+    "resilience/preemptions": (False, "nullable_number"),
+    "resilience/emergency_saves": (False, "nullable_number"),
+    "resilience/quarantined": (False, "nullable_number"),
+    "resilience/restarts": (False, "nullable_number"),
+    "resilience/resumed_step": (False, "nullable_number"),
+    "resilience/lost_steps": (False, "nullable_number"),
     "hbm_bytes_in_use": (False, "nullable_number"),
     "hbm_peak_bytes": (False, "nullable_number"),
     "hbm_bytes_limit": (False, "nullable_number"),
@@ -144,6 +157,12 @@ STEP_EVENT_FIELDS: Dict[str, tuple] = {
 #: ``fleet=`` dict; stoke_tpu.telemetry.fleet.FLEET_EVENT_FIELDS must match)
 FLEET_STEP_FIELDS = tuple(
     f for f in STEP_EVENT_FIELDS if f.startswith("fleet/")
+)
+
+#: the resilience subset of the schema (populated via ``build_step_event``'s
+#: ``resilience=`` dict; ResilienceMonitor.event_fields must match)
+RESILIENCE_STEP_FIELDS = tuple(
+    f for f in STEP_EVENT_FIELDS if f.startswith("resilience/")
 )
 
 
@@ -268,6 +287,7 @@ def build_step_event(
     hbm_peak_bytes: Optional[int] = None,
     hbm_bytes_limit: Optional[int] = None,
     fleet: Optional[Dict[str, Any]] = None,
+    resilience: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Assemble + validate a v1 step event (single construction point so the
     schema cannot drift from the writer)."""
@@ -359,6 +379,18 @@ def build_step_event(
         if unknown:
             raise ValueError(
                 f"unknown fleet step-event fields {sorted(unknown)}"
+            )
+    if resilience is not None:
+        # resilience counters (ISSUE 7): keys appear only when a
+        # ResilienceMonitor is attached; slash-named fields arrive as one
+        # dict like the fleet view's — unknown keys fail validation
+        for key in RESILIENCE_STEP_FIELDS:
+            value = resilience.get(key)
+            record[key] = None if value is None else float(value)
+        unknown = set(resilience) - set(RESILIENCE_STEP_FIELDS)
+        if unknown:
+            raise ValueError(
+                f"unknown resilience step-event fields {sorted(unknown)}"
             )
     validate_step_event(record)
     return record

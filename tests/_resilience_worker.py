@@ -1,0 +1,85 @@
+"""Chaos-test worker (tests/test_resilience.py end-to-end): a tiny
+deterministic training run that resumes on restart and records its loss
+trajectory + final params.
+
+Run under ``scripts/run_resilient.py`` with ``STOKE_CHAOS=kill_at_step=K``
+to exercise the whole detect→save→restart→resume loop; run clean for the
+uninterrupted reference trajectory.  Deterministic by construction: the
+batch stream is derived from a fixed seed and indexed by optimizer step,
+so a resumed attempt replays exactly the steps the preempted one never
+ran.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+IN, OUT = 8, 4
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", required=True,
+                    help="workdir: checkpoints + trajectory + final params")
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--resilience", action="store_true")
+    args = ap.parse_args()
+
+    import optax
+
+    from stoke_tpu import ResilienceConfig, Stoke, StokeOptimizer
+
+    configs = []
+    if args.resilience:
+        configs.append(ResilienceConfig(
+            save_path=os.path.join(args.root, "ckpts"),
+        ))
+    stoke = Stoke(
+        model=lambda p, x: x @ p["w"],
+        optimizer=StokeOptimizer(
+            optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.05}
+        ),
+        loss=lambda o, y: ((o - y) ** 2).mean(),
+        params={"w": np.ones((IN, OUT), np.float32) * 0.1},
+        batch_size_per_device=4,
+        configs=configs,
+        verbose=False,
+    )
+    if args.resilience:
+        stoke.resume()
+
+    rng = np.random.default_rng(7)
+    W = rng.normal(size=(IN, OUT)).astype(np.float32)
+    batches = []
+    for _ in range(args.steps):
+        x = rng.normal(size=(32, IN)).astype(np.float32)
+        batches.append((x, (x @ W).astype(np.float32)))
+
+    attempt = int(os.environ.get("STOKE_RESTART_ATTEMPT", "0") or 0)
+    start = stoke.optimizer_steps  # 0 fresh; K after a resume
+    with open(os.path.join(args.root, "trajectory.jsonl"), "a") as f:
+        for i in range(start, args.steps):
+            x, y = batches[i]
+            report = stoke.train_step(x, (y,))
+            f.write(json.dumps({
+                "step": stoke.optimizer_steps,
+                "loss": float(np.asarray(report)),
+                "attempt": attempt,
+            }) + "\n")
+            f.flush()
+
+    np.save(
+        os.path.join(args.root, "final_w.npy"),
+        np.asarray(stoke.params["w"]),
+    )
+    stoke.close_telemetry()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
