@@ -7,7 +7,7 @@
 CPU_ENV = env PYTHONPATH=$(CURDIR) JAX_PLATFORMS=cpu
 MESH_ENV = $(CPU_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-full test-fast test-telemetry test-collectives test-health test-attribution test-fleet test-autotune test-resilience autotune-smoke dryrun bench-smoke telemetry-smoke tpu-probe
+.PHONY: test test-full test-fast test-telemetry test-collectives test-health test-attribution test-fleet test-autotune test-resilience test-zero autotune-smoke dryrun bench-smoke telemetry-smoke tpu-probe
 
 test:            ## default tier (excludes @slow compile-heavy equivalence tests)
 	$(MESH_ENV) python -m pytest tests/ -x -q
@@ -42,6 +42,9 @@ test-autotune:   ## autotuner + compile-cache tests only (search/pruning/ledger/
 test-resilience: ## pod-scale resilience tests only (preemption save/resume/quarantine/chaos/supervisor)
 	$(MESH_ENV) python -m pytest tests/ -x -q -m resilience
 
+test-zero:       ## ZeRO-parity quantized-collective tests only (sharded weight updates x int8 wire)
+	$(MESH_ENV) python -m pytest tests/ -x -q -m zero
+
 autotune-smoke:  ## CPU-safe autotune sweep smoke (>= 4 subprocess trials, never touches the tunnel)
 	$(CPU_ENV) python scripts/autotune.py --smoke --no-persist
 
@@ -49,7 +52,7 @@ bench-smoke:     ## CPU-safe bench smoke (never touches the tunnel)
 	$(CPU_ENV) python bench.py --preset tiny
 
 telemetry-smoke: ## one JSONL-emitting CPU train step through the full telemetry pipeline
-	$(CPU_ENV) python scripts/telemetry_smoke.py
+	$(MESH_ENV) python scripts/telemetry_smoke.py
 
 tpu-probe:       ## 60s health probe of the real chip (tunnel-safe timeout)
 	timeout 60 python -c "import jax; print(jax.devices())"

@@ -143,6 +143,7 @@ def _emit_persisted(metric: str, capture_error: str,
             "steps_per_dispatch": rec.get("steps_per_dispatch"),
             "xla_flags": rec.get("xla_flags"),
             "comm_dtype": rec.get("comm_dtype"),
+            "comm_shard_tier": rec.get("comm_shard_tier"),
             "capture_error": capture_error,
             "note": "persisted last verified on-chip measurement "
             "(fresh capture failed; see capture_error and BENCH_NOTES.md)",
@@ -172,8 +173,8 @@ REGRESSION_TOLERANCE = 0.05
 #: as cross-configuration (A/B arms, seg sweeps) rather than a like-for-like
 #: regression
 _REGRESSION_CONFIG_KEYS = (
-    "xla_flags", "steps_per_dispatch", "comm_dtype", "health",
-    "attribution", "fleet", "tuned", "resilience",
+    "xla_flags", "steps_per_dispatch", "comm_dtype", "comm_shard_tier",
+    "health", "attribution", "fleet", "tuned", "resilience",
 )
 
 
@@ -339,6 +340,10 @@ def _supervise(argv, preset: str, requested: dict | None = None) -> int:
     # it as) the exact-training headline
     if requested and requested.get("comm_dtype"):
         run_metric += f"_comm_{requested['comm_dtype']}"
+    # a weight-update-sharded arm (ISSUE 8) trains under a different
+    # sharding tier AND collective schedule: its own metric name too
+    if requested and requested.get("comm_shard_tier"):
+        run_metric += f"_shard_{requested['comm_shard_tier']}"
     # Take the single-client tunnel lock BEFORE dialing anything (the probe
     # itself is a client).  A live holder means the measurement session is
     # busy writing the very records this run would cite — emit the
@@ -443,6 +448,18 @@ def main():
                     "size 1); on a pod it measures the bytes-on-wire win.  "
                     "A distinct configuration for the stale-substitution "
                     "and regression guards")
+    ap.add_argument("--comm-shard-tier", default=None,
+                    choices=["none", "oss", "sddp", "fsdp"],
+                    help="run the --comm-dtype arm under a sharding tier "
+                    "(ISSUE 8 weight-update sharding): quantized "
+                    "reduce-scatter of the gradient leg, shard-local "
+                    "optimizer step over the partitioned state, "
+                    "updated-param all-gather.  The result records the "
+                    "tier plus grad/param bytes-on-wire and compression "
+                    "columns.  'none' is the explicit replicated "
+                    "baseline.  Requires --comm-dtype; a distinct "
+                    "configuration for the stale-substitution and "
+                    "regression guards")
     ap.add_argument("--xla-flags", default="",
                     help="extra XLA_FLAGS for the measurement (A/B autotune "
                     "arms); applied in the worker BEFORE jax import.  An "
@@ -509,6 +526,12 @@ def main():
             "cifar10_basicnn_train_throughput"
             if args.preset == "tiny" else METRIC
         )
+        if args.comm_shard_tier:
+            # a tier sweep persists its winner under a tier-suffixed
+            # metric (scripts/autotune.py): the requested tier selects
+            # WHICH winner to replay, and that winner's knobs (comm_dtype
+            # included) become the run defaults below
+            tuned_metric += f"_shard_{args.comm_shard_tier}"
         tuned_rec = _load_results().get(f"autotune/{tuned_metric}")
         if tuned_rec is None:
             print(json.dumps({
@@ -529,6 +552,10 @@ def main():
             args.seg = int(spec["steps_per_dispatch"])
         if args.comm_dtype is None and spec.get("comm_dtype"):
             args.comm_dtype = spec["comm_dtype"]
+    if args.comm_shard_tier and not args.comm_dtype:
+        ap.error("--comm-shard-tier requires --comm-dtype (the tier arm "
+                 "measures the sharded transport's wire format; with "
+                 "--tuned the tier winner's swept dtype satisfies this)")
     if not args._worker:
         # XLA_FLAGS must be in the WORKER's environment at interpreter
         # start: flags are fixed at backend init, and the ambient
@@ -569,6 +596,7 @@ def main():
                 # an explicit transport arm is its own configuration; the
                 # default (no transport) accepts any record without one
                 "comm_dtype": args.comm_dtype,
+                "comm_shard_tier": args.comm_shard_tier,
             },
         ))
 
@@ -606,8 +634,11 @@ def main():
 
     tiny = args.preset == "tiny"
     # comm arms carry their own metric name (lossy-gradient training is a
-    # distinct configuration, never the exact-training headline)
+    # distinct configuration, never the exact-training headline); a
+    # weight-update-sharded tier (ISSUE 8) extends the name again
     comm_suffix = f"_comm_{args.comm_dtype}" if args.comm_dtype else ""
+    if args.comm_shard_tier:
+        comm_suffix += f"_shard_{args.comm_shard_tier}"
     on_accel = jax.default_backend() not in ("cpu",)
     batch = args.batch or (16 if tiny else 256)
     steps = args.steps or (3 if tiny else 30)
@@ -623,8 +654,16 @@ def main():
         model, jax.random.PRNGKey(0), np.zeros((2, 32, 32, 3), np.float32), train=False
     )
     run_configs = []
+    shard_tier = args.comm_shard_tier
     if args.comm_dtype:
-        run_configs.append(CommConfig(dtype=args.comm_dtype))
+        # oss keeps a replicated grad buffer, so shard_updates' auto
+        # default resolves REPLICATED there — the tier arm must opt in
+        # explicitly or its ledger rows would mislabel the replicated
+        # exchange as the sharded path (sddp/fsdp auto-engage)
+        run_configs.append(CommConfig(
+            dtype=args.comm_dtype,
+            shard_updates=True if shard_tier == "oss" else None,
+        ))
     if args.health or args.attribution_peak_tflops or args.fleet:
         # health (ISSUE 3) / attribution (ISSUE 4) / fleet (ISSUE 5) arms
         # all ride the telemetry pipeline (status-validated requirement)
@@ -699,6 +738,11 @@ def main():
         # the transport needs the distributed engine (status rule); on one
         # chip the mesh is 1-wide and the arm measures quantize overhead
         distributed="dp" if args.comm_dtype else None,
+        # ISSUE 8 tier arm: the sharded weight-update path engages
+        # automatically under sddp/fsdp (CommConfig.shard_updates auto)
+        oss=shard_tier in ("oss", "sddp"),
+        sddp=shard_tier == "sddp",
+        fsdp=shard_tier == "fsdp",
         precision=None if tiny else "bf16",
         configs=run_configs or None,
         model_train_kwargs={"train": True},
@@ -779,6 +823,19 @@ def main():
         result["xla_flags"] = args.xla_flags
     if args.comm_dtype:
         result["comm_dtype"] = args.comm_dtype
+        # analytic wire accounting of the measured configuration (ISSUE 8
+        # columns): grad leg pre-quant vs on-wire, the param all-gather
+        # leg under the sharded tiers, and the grad compression ratio
+        cb = stoke.comm_bytes or {}
+        result["comm_grad_bytes_prequant"] = cb.get("prequant")
+        result["comm_grad_bytes_onwire"] = cb.get("onwire")
+        result["comm_bytes_param_gather"] = cb.get("param_gather")
+        result["comm_compression"] = (
+            round(cb["prequant"] / cb["onwire"], 4)
+            if cb.get("onwire") else None
+        )
+    if shard_tier:
+        result["comm_shard_tier"] = shard_tier
     if args.health:
         h = stoke.health
         result["health"] = True
@@ -859,6 +916,7 @@ def main():
                 "xla_flags": args.xla_flags or None,
                 "steps_per_dispatch": per_call,
                 "comm_dtype": args.comm_dtype,
+                "comm_shard_tier": shard_tier,
                 "tuned": True if args.tuned else None,
                 "health": True if args.health else None,
                 "attribution": (
@@ -897,6 +955,23 @@ def main():
                 "backend": jax.default_backend(),
                 **({"xla_flags": args.xla_flags} if args.xla_flags else {}),
                 **({"comm_dtype": args.comm_dtype} if args.comm_dtype else {}),
+                **(
+                    {
+                        "comm_shard_tier": shard_tier,
+                        "comm_grad_bytes_prequant": result[
+                            "comm_grad_bytes_prequant"
+                        ],
+                        "comm_grad_bytes_onwire": result[
+                            "comm_grad_bytes_onwire"
+                        ],
+                        "comm_bytes_param_gather": result[
+                            "comm_bytes_param_gather"
+                        ],
+                        "comm_compression": result["comm_compression"],
+                    }
+                    if shard_tier
+                    else {}
+                ),
                 **(
                     {
                         "tuned": True,

@@ -120,8 +120,13 @@ def _run_trial(payload: dict) -> dict:
     # dp is a SWEEP-level decision, not a per-trial one: when any trial
     # sweeps comm_dtype, every trial (baseline included) runs under
     # distributed="dp" so the score compares wire formats, never the
-    # dp/no-dp switch itself
+    # dp/no-dp switch itself.  The sharding tier (ISSUE 8) follows the
+    # same rule: every trial of a --comm-shard-tier sweep runs under the
+    # tier, so a comm_dtype winner is measured against a same-tier
+    # baseline (the sddp/fsdp trials take the sharded weight-update path
+    # automatically — CommConfig.shard_updates auto-resolution)
     use_dp = bool(payload.get("dp") or spec.comm_dtype)
+    shard_tier = payload.get("comm_shard_tier")
     batch = spec.batch or (8 if smoke else 256)
     seg = spec.steps_per_dispatch or (2 if smoke else 10)
     model = BasicNN() if smoke else ResNet50(num_classes=10, cifar_stem=True)
@@ -138,7 +143,14 @@ def _run_trial(payload: dict) -> dict:
         AttributionConfig(peak_tflops=float(payload["peak_tflops"])),
     ]
     if spec.comm_dtype:
-        configs.append(CommConfig(dtype=spec.comm_dtype))
+        # oss tier: shard_updates' auto default resolves replicated, so
+        # the tier sweep must opt in explicitly — otherwise every trial
+        # measures the replicated exchange while the winner persists
+        # under the `_shard_oss` metric (sddp/fsdp auto-engage)
+        configs.append(CommConfig(
+            dtype=spec.comm_dtype,
+            shard_updates=True if shard_tier == "oss" else None,
+        ))
     stoke = Stoke(
         model=model,
         optimizer=StokeOptimizer(
@@ -152,6 +164,9 @@ def _run_trial(payload: dict) -> dict:
         batch_size_per_device=batch,
         device="tpu" if on_accel else "cpu",
         distributed="dp" if use_dp else None,
+        oss=shard_tier in ("oss", "sddp"),
+        sddp=shard_tier == "sddp",
+        fsdp=shard_tier == "fsdp",
         precision=None if smoke else "bf16",
         configs=configs,
         model_train_kwargs={"train": True},
@@ -347,6 +362,14 @@ def main() -> int:
     ap.add_argument("--comm-dtypes", default=None,
                     help="comma-separated wire dtypes to sweep (e.g. "
                     "bf16,int8); default: not swept")
+    ap.add_argument("--comm-shard-tier", default=None,
+                    choices=["none", "oss", "sddp", "fsdp"],
+                    help="run EVERY trial of the sweep under this sharding "
+                    "tier (ISSUE 8 weight-update sharding) — a sweep-level "
+                    "decision like dp, so a comm_dtype sweep measures the "
+                    "sharded wire formats against a same-tier baseline "
+                    "instead of confounding them with the tier switch.  "
+                    "The winner persists under a tier-suffixed metric")
     ap.add_argument("--flash-blocks", default=None,
                     help="flash block-size candidates (workload=flash; "
                     "default 128,256,512, smoke 64,128)")
@@ -361,6 +384,11 @@ def main() -> int:
     ap.add_argument("--no-persist", action="store_true",
                     help="run the sweep but skip the ledger write")
     args = ap.parse_args()
+    if args.comm_shard_tier and not args.comm_dtypes:
+        ap.error("--comm-shard-tier requires --comm-dtypes (a tier sweep "
+                 "without the wire-format knob never engages the sharded "
+                 "transport, yet would persist its winner under the "
+                 "tier-suffixed metric bench.py --tuned replays)")
 
     if args._trial is not None:
         # worker mode: measure one spec, emit one JSON line, exit
@@ -433,8 +461,16 @@ def main() -> int:
         # dp for EVERY trial of a comm sweep (baseline included), so the
         # comm_dtype knob is measured against a dp baseline instead of
         # confounding the wire format with the dp/no-dp switch
-        "dp": "comm_dtype" in space,
+        "dp": "comm_dtype" in space or bool(args.comm_shard_tier),
+        # sharding tier for EVERY trial (ISSUE 8): same sweep-level rule —
+        # the comm_dtype knob under a sharded tier is measured against a
+        # same-tier baseline
+        "comm_shard_tier": args.comm_shard_tier,
     }
+    if args.comm_shard_tier:
+        # the tier is part of the measured configuration: its winner must
+        # never shadow (nor be replayed as) the unsharded metric's
+        metric += f"_shard_{args.comm_shard_tier}"
 
     # tunnel discipline: a real (non-smoke) sweep dials the single-client
     # TPU relay once per trial — take the shared lock for the whole sweep
@@ -483,7 +519,14 @@ def main() -> int:
         backend = "cpu" if smoke else "tpu"
         record = persist_winner(
             args.ledger, metric, outcome, backend=backend,
-            extra={"workload": payload_base["workload"]},
+            extra={
+                "workload": payload_base["workload"],
+                **(
+                    {"comm_shard_tier": args.comm_shard_tier}
+                    if args.comm_shard_tier
+                    else {}
+                ),
+            },
         )
         summary["persisted"] = {
             "ledger": args.ledger,

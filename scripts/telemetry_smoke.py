@@ -7,8 +7,10 @@ event stream readable by the native frame parser, and — since ISSUE 3 — a
 forced post-mortem bundle with the flight-recorder ring, all-thread
 stacks, and run config.  Since ISSUE 6, one compile-cache warm start;
 since ISSUE 7, one preemption → emergency-save → resume cycle (manifest
-written, counters restored).  Prints the step record and a one-line
-verdict; exit 0 only when everything round-trips.
+written, counters restored); since ISSUE 8, one sharded-transport step
+(int8 reduce-scatter under sddp: param-gather bytes + compression in the
+JSONL).  Prints the step record and a one-line verdict; exit 0 only when
+everything round-trips.
 """
 
 from __future__ import annotations
@@ -158,6 +160,58 @@ def main() -> int:
     rz_first.close_telemetry()
     rz_resumed.close_telemetry()
 
+    # sharded quantized transport (ISSUE 8): one optimizer step through
+    # the weight-update-sharded path — int8 reduce-scatter + per-shard
+    # error feedback under sddp — with the JSONL recording BOTH wire legs
+    # (grad compression >= 3.5x analytic, param all-gather bytes) and the
+    # residual carried as per-replica partitions
+    from stoke_tpu import CommConfig, OSSConfig, SDDPConfig
+    from stoke_tpu.parallel.zero import ShardedGradTransport
+
+    import jax as _jax
+
+    world = len(_jax.devices("cpu"))
+    zr_dir = os.path.join(out_dir, "zero")
+    zr = Stoke(
+        model=lambda p, x: x @ p["w"],
+        optimizer=StokeOptimizer(
+            optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.1}
+        ),
+        loss=lambda o, y: ((o - y) ** 2).mean(),
+        params={"w": np.ones((8, 4), np.float32)},
+        batch_size_per_device=2,
+        distributed="dp",
+        oss=True,
+        sddp=True,
+        configs=[
+            CommConfig(dtype="int8", chunk_elems=32, bucket_mb=0.001),
+            OSSConfig(min_shard_size=1),
+            SDDPConfig(min_shard_size=1),
+            TelemetryConfig(
+                output_dir=zr_dir, log_every_n_steps=1, prometheus=False,
+                tensorboard=False, sample_device_time=False, track_hbm=False,
+            ),
+        ],
+        verbose=False,
+    )
+    zx = np.ones((2 * world, 8), np.float32)
+    zy = np.zeros((zx.shape[0], 4), np.float32)
+    zr.train_step(zx, (zy,))
+    zr.close_telemetry()
+    zero_rec = read_step_events(os.path.join(zr_dir, "steps.jsonl"))[-1]
+    zero_sharded = isinstance(zr._engine.transport, ShardedGradTransport)
+    zero_ok = (
+        zero_sharded
+        and (
+            world == 1  # 1-wide mesh moves nothing on the wire
+            or (
+                (zero_rec.get("comm_compression") or 0) >= 3.5
+                and (zero_rec.get("comm_bytes_param_gather") or 0) > 0
+            )
+        )
+        and "residual" in zr._comm_state
+    )
+
     records = read_step_events(os.path.join(out_dir, "steps.jsonl"))
     print(json.dumps(records[-1], sort_keys=True))
     rec = records[-1]
@@ -228,6 +282,7 @@ def main() -> int:
         and {"sentinels", "step_event"} <= ring_kinds
         and compile_cache_ok
         and resilience_ok
+        and zero_ok
     )
     print(json.dumps({
         "telemetry_smoke": "ok" if ok else "FAILED",
@@ -248,6 +303,9 @@ def main() -> int:
         "compile_cache_warm": cc_warm.compile_cache.stats(),
         "resilience_cycle": "ok" if resilience_ok else "FAILED",
         "resilience_resumed": rz_resumed.resilience_summary,
+        "zero_sharded_step": "ok" if zero_ok else "FAILED",
+        "zero_comm_compression": zero_rec.get("comm_compression"),
+        "zero_param_gather_bytes": zero_rec.get("comm_bytes_param_gather"),
     }))
     return 0 if ok else 1
 

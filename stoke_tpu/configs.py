@@ -238,7 +238,10 @@ class DataParallelConfig:
 @dataclass
 class CommConfig:
     """Gradient-transport layer: quantized gradient synchronization with
-    error feedback and bucketed flattening (ISSUE 2 tentpole).
+    error feedback and bucketed flattening (ISSUE 2 tentpole), plus the
+    ZeRO-parity sharded weight-update path under oss/sddp/fsdp (ISSUE 8:
+    quantized reduce-scatter → shard-local optimizer step → param
+    all-gather, with the error-feedback residual itself sharded).
 
     No reference equivalent (the reference's DDP gradient compression hooks
     were never surfaced; its gradients always sync fp32).  TPU-native
@@ -284,6 +287,19 @@ class CommConfig:
             overhead = 4/chunk_elems bytes/elem; 512 → ~0.8%).
         stochastic_rounding: unbiased stochastic rounding for int8
             (deterministic round-to-nearest when False — useful for tests).
+        shard_updates: weight-update sharding for the quantized exchange
+            (ISSUE 8, arXiv:2004.13336 + arXiv:2506.17615): the gradient
+            leg becomes a quantized reduce-scatter ONLY — each replica
+            dequantizes and optimizer-steps just its 1/N shard (the
+            error-feedback residual is itself sharded, 1/N memory per
+            replica) and the updated parameters all-gather back.  ``None``
+            (default) resolves automatically: sharded under the
+            sddp/fsdp tiers (whose sharded grad buffers the replicated
+            transport cannot serve), replicated under none/oss (the PR 2
+            path, unchanged).  ``True`` forces the sharded path (requires
+            an oss/sddp/fsdp tier and ``strategy="rs_ag"``); ``False``
+            forces the replicated path (illegal under sddp/fsdp).
+            Irrelevant for the ``fp32`` pass-through.
     """
 
     dtype: str = "fp32"
@@ -292,6 +308,22 @@ class CommConfig:
     strategy: str = "rs_ag"
     chunk_elems: int = 512
     stochastic_rounding: bool = True
+    shard_updates: Optional[bool] = None
+
+
+def comm_shard_updates(cfg: Optional["CommConfig"], tier: "ShardingOptions") -> bool:
+    """Resolve ``CommConfig.shard_updates``'s auto default against the
+    active sharding tier — the single source of truth shared by the status
+    legality rules and the engine's transport factory.  ``True`` means the
+    apply boundary runs the sharded weight-update path (quantized
+    reduce-scatter → shard-local step → param all-gather); ``False`` the
+    PR 2 replicated exchange.  Always ``False`` for an inactive transport
+    (no config / fp32 pass-through)."""
+    if cfg is None or cfg.dtype == "fp32":
+        return False
+    if cfg.shard_updates is not None:
+        return bool(cfg.shard_updates)
+    return tier in (ShardingOptions.sddp, ShardingOptions.fsdp)
 
 
 #: wire dtypes the transport understands (validated by the status layer)

@@ -48,7 +48,7 @@ from stoke_tpu.configs import (
     PrecisionOptions,
     StokeOptimizer,
 )
-from stoke_tpu.parallel.collectives import GradTransport
+from stoke_tpu.parallel.zero import make_transport
 from stoke_tpu.parallel.sharding import ShardingRules, place_global_tree
 from stoke_tpu.telemetry.collectors import xprof_span
 from stoke_tpu.telemetry.health import compute_sentinels
@@ -421,13 +421,12 @@ class StepEngine:
         # error feedback, applied ONCE per optimizer step inside the apply
         # core.  A None comm config (or dtype="fp32") makes the transport a
         # structural pass-through: the apply program is byte-for-byte the
-        # same as before the layer existed.
+        # same as before the layer existed.  Under the sharded tiers (or
+        # CommConfig.shard_updates) the factory returns the ISSUE 8
+        # weight-update-sharded variant: quantized reduce-scatter, sharded
+        # EF residual, shard-local update, param all-gather.
         self.comm = comm
-        self.transport = GradTransport(
-            comm,
-            rules.mesh if rules is not None else None,
-            rules.axis_name if rules is not None else "data",
-        )
+        self.transport = make_transport(comm, rules)
         # health sentinels (ISSUE 3): when on, the apply core additionally
         # returns a packed per-step diagnostics vector computed INSIDE the
         # same compiled program (zero extra dispatches).  When off, the

@@ -1312,6 +1312,16 @@ class Stoke:
         health acceptance counter: sentinels must not add dispatches)."""
         return self._engine.dispatch_count
 
+    @property
+    def comm_bytes(self) -> Optional[Dict[str, int]]:
+        """Analytic per-device bytes-on-wire of ONE optimizer step's
+        gradient exchange (None without a ``CommConfig``): ``prequant``
+        what the schedule moves in fp32, ``onwire`` what the configured
+        wire dtype moves, and — under the ISSUE 8 weight-update-sharded
+        path — ``param_gather``, the updated-parameter all-gather leg
+        (0 under fsdp, where params stay sharded)."""
+        return None if self._comm_bytes is None else dict(self._comm_bytes)
+
     def _maybe_emit_telemetry(self, window: int = 1) -> None:
         """Assemble + emit one structured step event at the telemetry
         cadence (JSONL / Prometheus / TB sinks).  Device->host transfers
@@ -1338,6 +1348,14 @@ class Stoke:
             t.registry.counter("comm/grad_bytes_onwire_total").inc(
                 self._comm_bytes["onwire"] * window
             )
+            # sharded weight-update path (ISSUE 8): the second wire leg —
+            # updated-parameter all-gather back to the tier placement
+            # (present only for a ShardedGradTransport; 0 under fsdp
+            # where params stay sharded)
+            if "param_gather" in self._comm_bytes:
+                t.registry.counter("comm/param_gather_bytes_total").inc(
+                    self._comm_bytes["param_gather"] * window
+                )
         if not self._crossed_boundary(
             self._optimizer_steps, t.config.log_every_n_steps, window
         ):
@@ -1729,10 +1747,15 @@ class Stoke:
         }
         if self._comm_state:
             # error-feedback residual (ISSUE 2 state): without it a
-            # resumed int8 run would drop the carried quantization error
-            state["comm_state"] = jax.tree_util.tree_map(
-                lambda x: np.asarray(jax.device_get(x)), self._comm_state
-            )
+            # resumed int8 run would drop the carried quantization error.
+            # _gather_to_host, not device_get: the ISSUE 8 sharded residual
+            # spans the GLOBAL data axis, and device_get raises on arrays
+            # with non-addressable shards — the consolidation gather is
+            # safe here because every rank enters the emergency save
+            # (the resilience boundary agreed on the flag collectively)
+            from stoke_tpu.io_ops import _gather_to_host
+
+            state["comm_state"] = _gather_to_host(self._comm_state)
         return state
 
     def _restore_resume_state(self, rs: Dict[str, Any]) -> None:

@@ -213,11 +213,19 @@ class GradTransport:
         if self.cfg is None:
             return None
         leaves = jax.tree_util.tree_leaves(params)
-        layout = self._layout([int(np.prod(l.shape)) if l.shape else 1
-                               for l in leaves])
-        elems = layout.total_padded_elems
+        layout = self._layout(self._leaf_sizes(leaves))
+        pre, wire = self._wire_bytes(layout.total_padded_elems, stages=2.0)
+        return {"prequant": pre, "onwire": wire}
+
+    def _wire_bytes(self, elems: int, stages: float) -> Tuple[int, int]:
+        """Per-device bytes of ``stages`` ring stages over one padded
+        payload — ``(N-1)/N × payload`` each — in fp32 (``pre``) vs the
+        configured wire dtype (``wire``; int8 = payload + one f32 scale
+        per chunk).  The one copy of the wire-format formula both the
+        replicated (2 stages) and the sharded (zero.py, 1 stage)
+        accountings cite."""
         chunks = elems // max(self.cfg.chunk_elems, 1)
-        ring = 2.0 * (self.world - 1) / max(self.world, 1)
+        ring = stages * (self.world - 1) / max(self.world, 1)
         pre = ring * 4.0 * elems
         if self.cfg.dtype == "fp32":
             wire = pre
@@ -225,7 +233,7 @@ class GradTransport:
             wire = ring * 2.0 * elems
         else:  # int8 payload + one f32 scale per chunk
             wire = ring * (1.0 * elems + 4.0 * chunks)
-        return {"prequant": int(pre), "onwire": int(wire)}
+        return int(pre), int(wire)
 
     # ----------------------------- apply ------------------------------- #
 
@@ -266,18 +274,33 @@ class GradTransport:
             self._layout_cache[key] = BucketLayout(sizes, bucket_elems, align)
         return self._layout_cache[key]
 
-    def _exchange_tree(self, tree: Any, rng: jax.Array) -> Any:
+    @staticmethod
+    def _leaf_sizes(leaves: List[Any]) -> List[int]:
+        return [int(np.prod(l.shape)) if l.shape else 1 for l in leaves]
+
+    def _bucketed_exchange(
+        self, tree: Any, rng: jax.Array, exchange: Any
+    ) -> Tuple[Any, List[Any]]:
+        """Shared flatten/pad/slice-out plumbing over the bucket layout:
+        concatenates each bucket's leaves into one padded flat f32 buffer,
+        calls ``exchange(bucket_index, flat, per_bucket_key) -> (out_flat,
+        extra)``, slices the outputs back to leaf shapes/dtypes, and
+        returns ``(tree, [extra per bucket])`` — the one copy of the
+        packing both the replicated and the sharded (zero.py) schedules
+        ride."""
         leaves, treedef = jax.tree_util.tree_flatten(tree)
-        sizes = [int(np.prod(l.shape)) if l.shape else 1 for l in leaves]
+        sizes = self._leaf_sizes(leaves)
         layout = self._layout(sizes)
         outs: List[Any] = [None] * len(leaves)
+        extras: List[Any] = []
         for b, (indices, elems, padded) in enumerate(layout.buckets):
             flat = jnp.concatenate(
                 [leaves[i].astype(jnp.float32).reshape(-1) for i in indices]
             )
             if padded > elems:
                 flat = jnp.pad(flat, (0, padded - elems))
-            out = self._exchange_flat(flat, jax.random.fold_in(rng, b))
+            out, extra = exchange(b, flat, jax.random.fold_in(rng, b))
+            extras.append(extra)
             off = 0
             for i in indices:
                 n = sizes[i]
@@ -287,7 +310,14 @@ class GradTransport:
                     .astype(leaves[i].dtype)
                 )
                 off += n
-        return jax.tree_util.tree_unflatten(treedef, outs)
+        return jax.tree_util.tree_unflatten(treedef, outs), extras
+
+    def _exchange_tree(self, tree: Any, rng: jax.Array) -> Any:
+        out, _ = self._bucketed_exchange(
+            tree, rng,
+            lambda b, flat, key: (self._exchange_flat(flat, key), None),
+        )
+        return out
 
     # ------------------------- flat exchange --------------------------- #
 

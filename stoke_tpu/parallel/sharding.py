@@ -167,6 +167,11 @@ class ShardingRules:
     grad_spec: Callable[[tuple], P]
     opt_spec: Callable[[tuple], P]
     overrides: Optional[Any] = None
+    #: the tier these rules were built from — consumed by the gradient
+    #: transport factory (ISSUE 8) to resolve ``CommConfig.shard_updates``'s
+    #: auto default and to know whether updated params all-gather at the
+    #: apply boundary (replicated-param tiers) or stay sharded (fsdp)
+    tier: ShardingOptions = ShardingOptions.none
 
     def param_shardings(self, tree_shapes):
         return sharding_tree(tree_shapes, self.mesh, self.param_spec, self.overrides)
@@ -271,14 +276,19 @@ def make_sharding_rules(
         fsdp_config.shard_axis_preference,
     )
     if tier is ShardingOptions.none:
-        return ShardingRules(mesh, axis_name, repl, repl, repl, overrides)
+        return ShardingRules(mesh, axis_name, repl, repl, repl, overrides, tier)
     if tier is ShardingOptions.oss:
-        return ShardingRules(mesh, axis_name, repl, repl, shard_opt, overrides)
+        return ShardingRules(mesh, axis_name, repl, repl, shard_opt, overrides, tier)
     if tier is ShardingOptions.sddp:
-        return ShardingRules(mesh, axis_name, repl, shard_grad, shard_opt, overrides)
+        return ShardingRules(
+            mesh, axis_name, repl, shard_grad, shard_opt, overrides, tier
+        )
     if tier is ShardingOptions.fsdp:
         # FSDP: params/grads/opt all follow the *param* placement so the
         # update is fully local (reference FSDP shards the flat param and
         # derives grad/opt shards from it, extensions.py:289-376).
-        return ShardingRules(mesh, axis_name, shard_param, shard_param, shard_param, overrides)
+        return ShardingRules(
+            mesh, axis_name, shard_param, shard_param, shard_param, overrides,
+            tier,
+        )
     raise ValueError(f"unknown sharding tier {tier}")

@@ -26,6 +26,7 @@ from stoke_tpu.configs import (
     ALL_CONFIG_CLASSES,
     COMM_DTYPES,
     COMM_STRATEGIES,
+    comm_shard_updates,
     FLEET_ACTIONS,
     HEALTH_ACTIONS,
     ActivationCheckpointingConfig,
@@ -374,12 +375,22 @@ class StokeStatus:
             )
 
         def _comm_invalid(s):
-            """Gradient-transport legality (ISSUE 2): a CommConfig that
-            would silently do nothing (no distributed engine), that names
-            an unknown dtype/strategy, or that combines quantization with
-            incompatible features (sharded grad buffers, fp16 loss
-            scalers) is rejected HERE — not at compile time, not
-            silently."""
+            """Gradient-transport legality (ISSUE 2, extended by ISSUE 8):
+            a CommConfig that would silently do nothing (no distributed
+            engine), that names an unknown dtype/strategy, or that
+            combines quantization with incompatible features is rejected
+            HERE — not at compile time, not silently.
+
+            The quantized wire format reaches every sharding tier now:
+            tiers none/oss keep PR 2's replicated exchange by default,
+            sddp/fsdp auto-engage the ISSUE 8 weight-update-sharded path
+            (quantized reduce-scatter → shard-local step → param
+            all-gather; ``CommConfig.shard_updates`` overrides either
+            way).  Still illegal: fp16 loss scalers with any lossy wire,
+            the replicated exchange forced under a sharded grad buffer,
+            sharded updates with nothing sharded (tier none) or with the
+            single-stage ``all_reduce`` schedule, and a missing data
+            axis."""
             cfg = self._configs.get("CommConfig")
             if cfg is None:
                 return False
@@ -407,32 +418,54 @@ class StokeStatus:
                     f"{cfg.chunk_elems}"
                 )
             if cfg.dtype == "fp32":
-                return False  # pass-through composes with everything
-            if s["sddp"] or s["fsdp"]:
-                # sddp/fsdp shard the gradient accumulation buffer over the
-                # data axis; the quantized transport assumes a replicated
-                # buffer it can reduce-scatter itself (quantizing an
-                # already-scattered buffer would double-shard).  oss is
-                # fine: opt-state sharding composes with a replicated
-                # gradient exchange (weight-update sharding, 2004.13336).
-                tier = "fsdp" if s["fsdp"] else "sddp"
-                return (
-                    f"CommConfig(dtype={cfg.dtype!r}) conflicts with "
-                    f"{tier} gradient sharding — the quantized transport "
-                    f"owns the gradient collective and needs the replicated "
-                    f"grad buffer of tiers none/oss"
-                )
+                return False  # exact pass-through composes with everything
             if s["precision"] is PrecisionOptions.fp16:
                 # fp16 carries dynamic loss scalers: the single-scaler mode
                 # stores SCALED grads in the buffer (quantization chunk
                 # scales would alias the loss scale) and per-loss mode
                 # updates scaler state from per-micro finiteness — both
-                # interact with lossy transport in ways v1 does not support
+                # interact with lossy transport in ways neither the
+                # replicated nor the sharded path supports
                 return (
                     f"CommConfig(dtype={cfg.dtype!r}) with precision='fp16' "
                     f"is unsupported — the dynamic loss scaler interacts "
                     f"with lossy gradient transport; use bf16 (the TPU "
                     f"path) or full precision"
+                )
+            tier = self.sharding_tier
+            if comm_shard_updates(cfg, tier):
+                # ISSUE 8 sharded weight-update path: quantized
+                # reduce-scatter → per-shard EF + dequantize → shard-local
+                # optimizer step → param all-gather
+                if tier is ShardingOptions.none:
+                    return (
+                        f"CommConfig(dtype={cfg.dtype!r}, shard_updates="
+                        f"True) needs a sharded tier — the weight-update-"
+                        f"sharded transport partitions the optimizer step "
+                        f"over the data axis; enable oss/sddp/fsdp or drop "
+                        f"shard_updates"
+                    )
+                if cfg.strategy != "rs_ag":
+                    return (
+                        f"CommConfig(strategy={cfg.strategy!r}) cannot "
+                        f"shard weight updates — the sharded path IS the "
+                        f"rs_ag schedule (quantized reduce-scatter + param "
+                        f"all-gather); the single-stage all_reduce assumes "
+                        f"every replica consumes the full gradient"
+                    )
+            elif s["sddp"] or s["fsdp"]:
+                # only reachable with an explicit shard_updates=False:
+                # sddp/fsdp shard the gradient accumulation buffer over the
+                # data axis and the REPLICATED transport needs the
+                # replicated grad buffer of tiers none/oss
+                tier_name = "fsdp" if s["fsdp"] else "sddp"
+                return (
+                    f"CommConfig(dtype={cfg.dtype!r}, shard_updates=False) "
+                    f"forces the replicated gradient exchange under "
+                    f"{tier_name} gradient sharding — the replicated "
+                    f"transport needs the replicated grad buffer of tiers "
+                    f"none/oss; drop shard_updates to use the sharded "
+                    f"weight-update path"
                 )
             dp = self._configs.get("DataParallelConfig")
             axis = dp.axis_name if dp is not None else "data"

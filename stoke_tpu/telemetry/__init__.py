@@ -427,6 +427,13 @@ class Telemetry:
             comm_ratio = comm_pre / comm_wire if comm_wire else None
         else:
             comm_pre = comm_wire = comm_ratio = None
+        # second wire leg (ISSUE 8): the updated-parameter all-gather of
+        # the weight-update-sharded path; the counter exists only when the
+        # facade runs a sharded transport — absent, the field rides null
+        if self.registry.get("comm/param_gather_bytes_total") is not None:
+            comm_gather = self._delta("comm/param_gather_bytes_total")
+        else:
+            comm_gather = None
 
         if self.compile_tracker is not None:
             compiles = self.compile_tracker.compiles
@@ -498,6 +505,7 @@ class Telemetry:
             skipped_steps=skipped_steps,
             comm_bytes_prequant=comm_pre,
             comm_bytes_onwire=comm_wire,
+            comm_bytes_param_gather=comm_gather,
             comm_compression=comm_ratio,
             comm_residual_norm=comm_residual_norm,
             param_norm=param_norm,

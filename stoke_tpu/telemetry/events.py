@@ -68,9 +68,14 @@ STEP_EVENT_FIELDS: Dict[str, tuple] = {
     # per-window bytes the gradient exchange moves per device — prequant is
     # the fp32 schedule's bytes, onwire the configured wire dtype's;
     # compression = prequant/onwire; residual_norm gauges the carried
-    # error-feedback residual
+    # error-feedback residual (the SHARDED residual's global norm under the
+    # ISSUE 8 weight-update-sharded path — same units, 1/N of it per
+    # replica).  param_gather (ISSUE 8; null unless the sharded path is
+    # active) is the second wire leg: the updated-parameter all-gather back
+    # to the replicated tier placement after the shard-local step
     "comm_bytes_prequant": (False, "nullable_number"),
     "comm_bytes_onwire": (False, "nullable_number"),
+    "comm_bytes_param_gather": (False, "nullable_number"),
     "comm_compression": (False, "nullable_number"),
     "comm_residual_norm": (False, "nullable_number"),
     # health sentinels (ISSUE 3; null without a HealthConfig): per-step
@@ -262,6 +267,7 @@ def build_step_event(
     skipped_steps: float = 0.0,
     comm_bytes_prequant: Optional[float] = None,
     comm_bytes_onwire: Optional[float] = None,
+    comm_bytes_param_gather: Optional[float] = None,
     comm_compression: Optional[float] = None,
     comm_residual_norm: Optional[float] = None,
     param_norm: Optional[float] = None,
@@ -321,6 +327,11 @@ def build_step_event(
         ),
         "comm_bytes_onwire": (
             None if comm_bytes_onwire is None else float(comm_bytes_onwire)
+        ),
+        "comm_bytes_param_gather": (
+            None
+            if comm_bytes_param_gather is None
+            else float(comm_bytes_param_gather)
         ),
         "comm_compression": _round(comm_compression, 4),
         "comm_residual_norm": _round(comm_residual_norm),

@@ -66,7 +66,7 @@ GLOBAL_BATCH = 32
 
 
 def make_stoke(fmt=CheckpointFormat.consolidated, fsdp=False, async_save=False,
-               save_rank=0, extra_configs=()):
+               save_rank=0, extra_configs=(), oss=False, sddp=False):
     params = {
         "w": jnp.asarray(
             np.random.default_rng(7).normal(size=(IN, OUT)).astype(np.float32) * 0.1
@@ -94,6 +94,8 @@ def make_stoke(fmt=CheckpointFormat.consolidated, fsdp=False, async_save=False,
         batch_size_per_device=GLOBAL_BATCH // 8,
         distributed="dp",
         fsdp=fsdp,
+        oss=oss,
+        sddp=sddp,
         verbose=False,
         configs=cfgs,
     )
@@ -457,6 +459,44 @@ def main():
             raise AssertionError("indivisible per-process batch accepted")
         except ValueError as e:
             assert "per-process" in str(e)
+
+    elif SCENARIO == "zero":
+        # ISSUE 8 acceptance across 2 real processes: int8 quantized
+        # reduce-scatter + per-shard error feedback + shard-local update
+        # + param all-gather under sddp.  Both ranks must end with
+        # IDENTICAL post-step params (the all-gathered replicated value —
+        # asserted by the pytest side on the per-rank dumps), and each
+        # rank's residual buffers must be partitioned over the global
+        # 8-device data axis.
+        from jax.sharding import PartitionSpec
+
+        from stoke_tpu import CommConfig, OSSConfig, SDDPConfig
+        from stoke_tpu.parallel.zero import ShardedGradTransport
+
+        s = make_stoke(
+            oss=True,
+            sddp=True,
+            extra_configs=(
+                CommConfig(dtype="int8", chunk_elems=64, bucket_mb=0.01),
+                OSSConfig(min_shard_size=1),
+                SDDPConfig(min_shard_size=1),
+            ),
+        )
+        assert isinstance(s._engine.transport, ShardedGradTransport)
+        train(s, steps=2)
+        assert s.optimizer_steps == 2
+        for buf in s._comm_state["residual"]:
+            assert buf.sharding.spec == PartitionSpec("data")
+            # 8 global devices, 4 local: this process materializes half
+            local = sum(
+                sh.data.shape[0] for sh in buf.addressable_shards
+            )
+            assert local * NPROC == buf.shape[0], (local, buf.shape)
+        # the wire accounting sees the full 8-wide axis
+        assert s.comm_bytes["onwire"] > 0
+        assert s.comm_bytes["param_gather"] > 0
+        w = np.asarray(jax.device_get(s.params["w"]))
+        np.save(os.path.join(TMP, f"zero_params_p{PID}.npy"), w)
 
     else:
         raise SystemExit(f"unknown scenario {SCENARIO}")

@@ -6,6 +6,7 @@ import pytest
 from stoke_tpu import (
     ClipGradConfig,
     ClipGradNormConfig,
+    CommConfig,
     DataParallelConfig,
     DeviceOptions,
     DistributedOptions,
@@ -205,6 +206,87 @@ MATRIX = [
             configs=[OffloadOptimizerConfig(fallback_to_device=False)],
         ),
         False,
+    ),
+    # quantized transport x sharding tiers (ISSUE 8 legality matrix):
+    # sddp/fsdp auto-engage the weight-update-sharded path — LEGAL now
+    (
+        dict(batch_size_per_device=8, distributed="dp", oss=True, sddp=True,
+             configs=[CommConfig(dtype="int8")]),
+        False,
+    ),
+    (
+        dict(batch_size_per_device=8, distributed="dp", fsdp=True,
+             configs=[CommConfig(dtype="int8")]),
+        False,
+    ),
+    (
+        dict(batch_size_per_device=8, distributed="dp", oss=True, sddp=True,
+             configs=[CommConfig(dtype="bf16")]),
+        False,
+    ),
+    # explicit sharded updates under oss (weight-update sharding opt-in)
+    (
+        dict(batch_size_per_device=8, distributed="dp", oss=True,
+             configs=[CommConfig(dtype="int8", shard_updates=True)]),
+        False,
+    ),
+    (
+        dict(batch_size_per_device=8, distributed="dp", fsdp=True,
+             configs=[CommConfig(dtype="int8", shard_updates=True)]),
+        False,
+    ),
+    # fp32 pass-through composes with every tier, shard_updates irrelevant
+    (
+        dict(batch_size_per_device=8, distributed="dp", fsdp=True,
+             configs=[CommConfig(dtype="fp32", shard_updates=True)]),
+        False,
+    ),
+    # STILL illegal: forcing the replicated exchange under a sharded
+    # grad buffer
+    (
+        dict(batch_size_per_device=8, distributed="dp", oss=True, sddp=True,
+             configs=[CommConfig(dtype="int8", shard_updates=False)]),
+        True,
+    ),
+    (
+        dict(batch_size_per_device=8, distributed="dp", fsdp=True,
+             configs=[CommConfig(dtype="bf16", shard_updates=False)]),
+        True,
+    ),
+    # STILL illegal: sharded updates with nothing sharded (tier none)
+    (
+        dict(batch_size_per_device=8, distributed="dp",
+             configs=[CommConfig(dtype="int8", shard_updates=True)]),
+        True,
+    ),
+    # STILL illegal: the single-stage all_reduce schedule cannot shard
+    (
+        dict(batch_size_per_device=8, distributed="dp", oss=True, sddp=True,
+             configs=[CommConfig(dtype="int8", strategy="all_reduce")]),
+        True,
+    ),
+    # STILL illegal: fp16 dynamic loss scalers with any lossy wire —
+    # sharded tier or not
+    (
+        dict(batch_size_per_device=8, distributed="dp", oss=True, sddp=True,
+             precision="fp16", configs=[CommConfig(dtype="int8")]),
+        True,
+    ),
+    (
+        dict(batch_size_per_device=8, distributed="dp", precision="fp16",
+             configs=[CommConfig(dtype="bf16")]),
+        True,
+    ),
+    # STILL illegal: unknown dtype/strategy, whatever the tier
+    (
+        dict(batch_size_per_device=8, distributed="dp", oss=True, sddp=True,
+             configs=[CommConfig(dtype="int4")]),
+        True,
+    ),
+    (
+        dict(batch_size_per_device=8, distributed="dp", fsdp=True,
+             configs=[CommConfig(strategy="ring", dtype="int8")]),
+        True,
     ),
 ]
 
