@@ -7,7 +7,7 @@
 CPU_ENV = env PYTHONPATH=$(CURDIR) JAX_PLATFORMS=cpu
 MESH_ENV = $(CPU_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-full test-fast test-telemetry test-collectives test-health test-attribution test-fleet test-autotune test-resilience test-zero autotune-smoke dryrun bench-smoke telemetry-smoke tpu-probe
+.PHONY: test test-full test-fast test-telemetry test-collectives test-health test-attribution test-fleet test-autotune test-resilience test-zero test-serving autotune-smoke dryrun bench-smoke telemetry-smoke serve-smoke tpu-probe
 
 test:            ## default tier (excludes @slow compile-heavy equivalence tests)
 	$(MESH_ENV) python -m pytest tests/ -x -q
@@ -44,6 +44,12 @@ test-resilience: ## pod-scale resilience tests only (preemption save/resume/quar
 
 test-zero:       ## ZeRO-parity quantized-collective tests only (sharded weight updates x int8 wire)
 	$(MESH_ENV) python -m pytest tests/ -x -q -m zero
+
+test-serving:    ## serving-stack tests only (paged KV decode parity/continuous batching/quantization)
+	$(MESH_ENV) python -m pytest tests/ -x -q -m serving
+
+serve-smoke:     ## CPU-safe continuous-batching serve smoke (Poisson trace, never touches the tunnel)
+	$(CPU_ENV) python bench.py --preset tiny --serve
 
 autotune-smoke:  ## CPU-safe autotune sweep smoke (>= 4 subprocess trials, never touches the tunnel)
 	$(CPU_ENV) python scripts/autotune.py --smoke --no-persist

@@ -43,6 +43,11 @@ import sys
 import time
 
 A100_BASELINE_IMGS_PER_SEC = 20000.0
+#: serve-arm comparison point (ISSUE 9): rough tokens/s of a GPT-small-class
+#: model under continuous batching on one A100 (vLLM-style paged serving,
+#: greedy decode, mixed 8-64 token prompts) — the same "fixed constant
+#: estimate" role A100_BASELINE_IMGS_PER_SEC plays for the training headline
+A100_BASELINE_SERVE_TOKENS_PER_SEC = 2000.0
 WATCHDOG_SECONDS = 1500
 PROBE_TIMEOUT = 120
 PROBE_ATTEMPTS = 3
@@ -128,11 +133,20 @@ def _emit_persisted(metric: str, capture_error: str,
                 rec = None
                 break
     if rec and rec.get("value", 0) > 0:
+        # serve records are tokens/s against the serving baseline — the
+        # training imgs/s constant would misreport them 10x low
+        baseline = (
+            A100_BASELINE_SERVE_TOKENS_PER_SEC
+            if rec.get("serve")
+            else A100_BASELINE_IMGS_PER_SEC
+        )
         out = {
             "metric": metric,
             "value": rec["value"],
-            "unit": rec.get("unit", "imgs/sec/chip"),
-            "vs_baseline": round(rec["value"] / A100_BASELINE_IMGS_PER_SEC, 4),
+            "unit": rec.get(
+                "unit", "tokens/sec" if rec.get("serve") else "imgs/sec/chip"
+            ),
+            "vs_baseline": round(rec["value"] / baseline, 4),
             "fresh": False,
             "stale": True,
             "backend": record_backend(rec),
@@ -144,6 +158,22 @@ def _emit_persisted(metric: str, capture_error: str,
             "xla_flags": rec.get("xla_flags"),
             "comm_dtype": rec.get("comm_dtype"),
             "comm_shard_tier": rec.get("comm_shard_tier"),
+            # serve columns ride the stale emit too (absent for training
+            # records): consumers of a re-cited serve capture still see
+            # its latency/occupancy/quant descriptor
+            **(
+                {
+                    k: rec.get(k)
+                    for k in (
+                        "serve", "serve_quant", "serve_max_seqs",
+                        "ttft_p50_s", "ttft_p99_s", "tpot_p50_s",
+                        "tpot_p99_s", "batch_fill_mean",
+                        "kv_occupancy_peak", "quant_compression",
+                    )
+                }
+                if rec.get("serve")
+                else {}
+            ),
             "capture_error": capture_error,
             "note": "persisted last verified on-chip measurement "
             "(fresh capture failed; see capture_error and BENCH_NOTES.md)",
@@ -175,6 +205,7 @@ REGRESSION_TOLERANCE = 0.05
 _REGRESSION_CONFIG_KEYS = (
     "xla_flags", "steps_per_dispatch", "comm_dtype", "comm_shard_tier",
     "health", "attribution", "fleet", "tuned", "resilience",
+    "serve", "serve_quant", "serve_max_seqs",
 )
 
 
@@ -220,6 +251,17 @@ def check_regression(
                 )
         return out
     return None
+
+
+def _serve_metric_name(preset: str, quant: str | None) -> str:
+    """Serve-arm metric id: model size follows the preset, lossy-weight
+    serving carries a quant suffix (a distinct metric for the
+    stale-substitution and regression guards, like the comm arms)."""
+    size = "tiny" if preset == "tiny" else "small"
+    name = f"gpt_{size}_serve_throughput"
+    if quant and quant != "none":
+        name += f"_quant_{quant}"
+    return name
 
 
 def _missing_flag_tokens(requested: str, env_flags: str) -> list:
@@ -344,6 +386,11 @@ def _supervise(argv, preset: str, requested: dict | None = None) -> int:
     # sharding tier AND collective schedule: its own metric name too
     if requested and requested.get("comm_shard_tier"):
         run_metric += f"_shard_{requested['comm_shard_tier']}"
+    # the serve arm (ISSUE 9) measures a different workload entirely
+    # (continuous-batching decode tokens/s): its own metric name, with a
+    # quant suffix so lossy-weight serving never cites the exact record
+    if requested and requested.get("serve"):
+        run_metric = _serve_metric_name(preset, requested.get("serve_quant"))
     # Take the single-client tunnel lock BEFORE dialing anything (the probe
     # itself is a client).  A live holder means the measurement session is
     # busy writing the very records this run would cite — emit the
@@ -416,6 +463,158 @@ def _supervise(argv, preset: str, requested: dict | None = None) -> int:
             except OSError:
                 pass
     return _emit_persisted(run_metric, detail, requested)
+
+
+def _serve_bench(args, tiny: bool) -> int:
+    """Serving bench arm (ISSUE 9 satellite): a synthetic Poisson request
+    trace through the continuous-batching engine.
+
+    Two passes over the same trace: the first warms every compiled
+    prefill bucket + the decode program, the second is the measurement —
+    steady-state serving is what the metric claims (compile seconds are
+    the AOT ledger's job, not this arm's).  Emits ONE JSON line with
+    tokens/s as ``value`` plus the p50/p99 TTFT & TPOT, KV-block
+    occupancy, and batch-fill columns, and persists an on-accelerator
+    capture to the ledger under its own metric + config keys.
+    """
+    import numpy as np
+
+    import jax
+
+    from stoke_tpu.configs import ServeConfig
+    from stoke_tpu.models.gpt import GPT
+    from stoke_tpu.serving import ServingEngine
+    from stoke_tpu.utils import init_module
+
+    on_accel = jax.default_backend() not in ("cpu",)
+    metric = _serve_metric_name(args.preset, args.serve_quant)
+    size = "tiny" if tiny else "small"
+    vocab = 1024 if tiny else 8192
+    model = GPT(
+        vocab_size=vocab, size_name=size, max_len=512, dropout_rate=0.0
+    )
+    variables = init_module(
+        model, jax.random.PRNGKey(0), np.zeros((1, 8), np.int32), train=False
+    )
+    cfg = ServeConfig(
+        max_seqs=args.serve_max_seqs,
+        kv_block_size=16,
+        max_seq_len=256,
+        max_new_tokens=32,
+        prefill_pad_multiple=32,
+        quant=args.serve_quant,
+        quant_min_size=256,
+    )
+    eng = ServingEngine(model, variables["params"], cfg)
+
+    n = args.serve_requests or (8 if tiny else 48)
+    r = np.random.default_rng(0)
+    prompts = [
+        r.integers(1, vocab, size=int(L)).astype(np.int32)
+        for L in r.integers(8, 65, size=n)
+    ]
+    out_lens = r.integers(8, 33, size=n)
+    # Poisson arrivals: exponential inter-arrivals at a rate that keeps
+    # the queue pressured (continuous batching has something to do)
+    arrivals = np.cumsum(r.exponential(0.02 if tiny else 0.05, size=n))
+
+    def trace_pass():
+        fills, occs = [], []
+        i = 0
+        base = time.perf_counter()
+        tokens0 = eng.metrics.tokens_out.value
+        while i < n or eng.scheduler.has_work:
+            now = time.perf_counter() - base
+            while i < n and arrivals[i] <= now:
+                eng.submit(prompts[i], int(out_lens[i]))
+                i += 1
+            if eng.scheduler.has_work:
+                eng.step()
+                fills.append(eng.scheduler.batch_fill)
+                occs.append(eng.allocator.occupancy)
+            elif i < n:
+                time.sleep(min(max(arrivals[i] - now, 0.0), 0.01))
+        dt = time.perf_counter() - base
+        return {
+            "wall_s": dt,
+            "tokens": eng.metrics.tokens_out.value - tokens0,
+            "batch_fill_mean": float(np.mean(fills)) if fills else 0.0,
+            "kv_occupancy_peak": float(np.max(occs)) if occs else 0.0,
+        }
+
+    trace_pass()  # warm pass: compiles every prefill bucket + decode
+    # steady-state latency is the claim: drop the warm pass's compile-
+    # dominated TTFT/TPOT samples before the measured pass
+    eng.metrics.reset_latency_reservoirs()
+    measured = trace_pass()
+    tokens_per_s = measured["tokens"] / max(measured["wall_s"], 1e-9)
+    pct = eng.metrics.latency_percentiles()
+    result = {
+        "metric": metric,
+        "value": round(tokens_per_s, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(
+            tokens_per_s / A100_BASELINE_SERVE_TOKENS_PER_SEC, 4
+        ),
+        "serve": True,
+        "serve_quant": args.serve_quant,
+        "serve_max_seqs": cfg.max_seqs,
+        "requests": n,
+        "ttft_p50_s": round(pct["ttft_p50_s"], 6),
+        "ttft_p99_s": round(pct["ttft_p99_s"], 6),
+        "tpot_p50_s": round(pct["tpot_p50_s"], 6),
+        "tpot_p99_s": round(pct["tpot_p99_s"], 6),
+        "batch_fill_mean": round(measured["batch_fill_mean"], 4),
+        "kv_occupancy_peak": round(measured["kv_occupancy_peak"], 4),
+        "kv_occupancy_final": eng.allocator.occupancy,
+        "quant_compression": round(eng.quant_stats["compression"], 4),
+        "on_accelerator": on_accel,
+        "fresh": True,
+        "measured_on": time.strftime("%Y-%m-%d"),
+    }
+    if on_accel:
+        regression = check_regression(
+            metric, result["value"],
+            config={
+                "serve": True,
+                "serve_quant": args.serve_quant,
+                "serve_max_seqs": cfg.max_seqs,
+            },
+        )
+        if regression is not None:
+            result["regression"] = regression
+            print(
+                f"bench.py REGRESSION: {metric} fresh {result['value']} is "
+                f"{regression['ratio']:.2%} of ledger best "
+                f"{regression['best']}",
+                file=sys.stderr,
+            )
+    print(json.dumps(result))
+    if on_accel:
+        persist_result(
+            metric,
+            {
+                "value": result["value"],
+                "unit": result["unit"],
+                "vs_baseline": result["vs_baseline"],
+                "date": result["measured_on"],
+                "source": "bench.py --serve fresh capture",
+                "backend": jax.default_backend(),
+                "serve": True,
+                "serve_quant": args.serve_quant,
+                "serve_max_seqs": cfg.max_seqs,
+                "requests": n,
+                "ttft_p50_s": result["ttft_p50_s"],
+                "ttft_p99_s": result["ttft_p99_s"],
+                "tpot_p50_s": result["tpot_p50_s"],
+                "tpot_p99_s": result["tpot_p99_s"],
+                "batch_fill_mean": result["batch_fill_mean"],
+                "kv_occupancy_peak": result["kv_occupancy_peak"],
+                "quant_compression": result["quant_compression"],
+            },
+            keep_best=True,
+        )
+    return 0
 
 
 def main():
@@ -514,6 +713,30 @@ def main():
                     "restarts/resumed_step/lost_steps columns in the "
                     "ledger descriptor.  A distinct configuration for the "
                     "stale-substitution and regression guards")
+    ap.add_argument("--serve", action="store_true",
+                    help="serving bench arm (ISSUE 9): a synthetic request "
+                    "trace (Poisson arrivals, mixed prompt/output lengths) "
+                    "through the continuous-batching engine — paged "
+                    "KV-cache, prefill/decode split, greedy decode.  "
+                    "Measures generated tokens/s and records p50/p99 "
+                    "TTFT & TPOT, kv_block_occupancy, and batch-fill "
+                    "columns.  Its own metric (never substituted for the "
+                    "training headline); model size follows --preset "
+                    "(tiny -> GPT-tiny, full -> GPT-small)")
+    ap.add_argument("--serve-quant", default="none",
+                    choices=["none", "bf16", "int8"],
+                    help="weight quantization for the --serve arm "
+                    "(ServeConfig.quant; int8 reuses the PR-2 per-chunk "
+                    "stochastic-rounding wire format on the weights).  A "
+                    "lossy-weight capture is a distinct metric for the "
+                    "stale-substitution and regression guards")
+    ap.add_argument("--serve-max-seqs", type=int, default=8,
+                    help="decode slot count of the --serve arm (the "
+                    "continuous-batching batch size); a distinct "
+                    "configuration for the regression guard")
+    ap.add_argument("--serve-requests", type=int, default=None,
+                    help="requests in the synthetic trace (default: 8 "
+                    "tiny / 48 full)")
     ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
     tuned_rec = None
@@ -573,6 +796,15 @@ def main():
         sys.exit(_supervise(
             sys.argv[1:], args.preset,
             requested={
+                "serve": True if args.serve else None,
+                "serve_quant": (
+                    args.serve_quant
+                    if args.serve and args.serve_quant != "none"
+                    else None
+                ),
+                "serve_max_seqs": (
+                    args.serve_max_seqs if args.serve else None
+                ),
                 "tuned": True if args.tuned else None,
                 "fleet": True if args.fleet else None,
                 "health": True if args.health else None,
@@ -633,6 +865,8 @@ def main():
     from stoke_tpu.models import BasicNN, ResNet50
 
     tiny = args.preset == "tiny"
+    if args.serve:
+        sys.exit(_serve_bench(args, tiny))
     # comm arms carry their own metric name (lossy-gradient training is a
     # distinct configuration, never the exact-training headline); a
     # weight-update-sharded tier (ISSUE 8) extends the name again

@@ -71,10 +71,23 @@ def main():
                     "flight recorder writing post-mortem bundles under the "
                     "telemetry dir (requires --telemetry; docs/"
                     "observability.md \"Training health & post-mortems\")")
+    ap.add_argument("--serve", type=int, default=0, metavar="N",
+                    help="after training, serve N prompts from the corpus "
+                    "through the continuous-batching engine (paged "
+                    "KV-cache, prefill/decode split — docs/serving.md) "
+                    "and report TTFT/TPOT plus how many continuations "
+                    "match the true arithmetic progression")
+    ap.add_argument("--serve-quant", default="none",
+                    choices=["none", "bf16", "int8"],
+                    help="weight quantization for the --serve engine")
     args = ap.parse_args()
     if args.health and not args.telemetry:
         ap.error("--health requires --telemetry DIR (sentinels surface "
                  "through the telemetry step events)")
+    if args.serve and args.attention == "ring":
+        ap.error("--serve supports dense/flash attention (the serving "
+                 "engine runs single-host; ring is the training-side "
+                 "sequence-parallel transform)")
 
     attention_fn, is_causal, mesh_cfgs = None, False, []
     if args.attention == "flash":
@@ -115,6 +128,24 @@ def main():
         from stoke_tpu import HealthConfig
 
         configs.append(HealthConfig())
+    serve_pad = serve_max_len = None
+    if args.serve:
+        from stoke_tpu import ServeConfig
+
+        # the padding bucket must round a full prompt UP without passing
+        # the model's position table: round max_seq_len DOWN to the
+        # bucket (e.g. --seq-len 100 -> bucket 32, serve cap 96)
+        serve_pad = min(32, args.seq_len)
+        serve_max_len = (args.seq_len // serve_pad) * serve_pad
+        configs.append(ServeConfig(
+            max_seqs=8,
+            kv_block_size=16,
+            max_seq_len=serve_max_len,
+            max_new_tokens=16,
+            prefill_pad_multiple=serve_pad,
+            attention="flash" if args.attention == "flash" else "dense",
+            quant=args.serve_quant,
+        ))
     stoke = Stoke(
         model=model,
         optimizer=StokeOptimizer(
@@ -153,6 +184,34 @@ def main():
         stoke.print_on_devices(
             f"health: {stoke.health.anomaly_count} anomalies "
             f"({stoke.health.anomaly_counts_by_detector() or 'clean run'})"
+        )
+    if args.serve:
+        # serve the model we just trained: prompts are progression
+        # prefixes, so a converged LM's greedy continuation should BE the
+        # progression — serving quality is directly checkable
+        engine = stoke.serve()
+        r = np.random.default_rng(1)
+        n_gen = min(16, serve_max_len // 2)
+        prompts, truths = [], []
+        for _ in range(args.serve):
+            row = corpus[int(r.integers(0, corpus.shape[0]))]
+            cut = int(r.integers(min(8, serve_max_len - n_gen - 1),
+                                 serve_max_len - n_gen))
+            prompts.append(row[:cut])
+            truths.append(row[cut : cut + n_gen])
+        streams = engine.generate(prompts, max_new_tokens=n_gen)
+        exact = sum(
+            int(np.array_equal(np.array(s), t))
+            for s, t in zip(streams, truths)
+        )
+        s = engine.summary()
+        stoke.print_on_devices(
+            f"serve: {args.serve} requests, {s['tokens_out']:.0f} tokens, "
+            f"{exact}/{args.serve} continuations exactly match the "
+            f"progression | ttft p50 {s['ttft_p50_s'] * 1e3:.1f}ms "
+            f"p99 {s['ttft_p99_s'] * 1e3:.1f}ms, tpot p50 "
+            f"{(s['tpot_p50_s'] or 0) * 1e3:.1f}ms | quant "
+            f"{args.serve_quant} ({s['quant']['compression']:.2f}x)"
         )
     if args.telemetry:
         stoke.close_telemetry()

@@ -9,8 +9,11 @@ stacks, and run config.  Since ISSUE 6, one compile-cache warm start;
 since ISSUE 7, one preemption → emergency-save → resume cycle (manifest
 written, counters restored); since ISSUE 8, one sharded-transport step
 (int8 reduce-scatter under sddp: param-gather bytes + compression in the
-JSONL).  Prints the step record and a one-line verdict; exit 0 only when
-everything round-trips.
+JSONL); since ISSUE 9, one serve cycle (two concurrent requests through
+the continuous-batching paged-KV engine with int8 weights: TTFT/TPOT
+fields in the JSONL, >= 3.5x compression asserted, blocks drained back
+to the pool).  Prints the step record and a one-line verdict; exit 0
+only when everything round-trips.
 """
 
 from __future__ import annotations
@@ -212,6 +215,73 @@ def main() -> int:
         and "residual" in zr._comm_state
     )
 
+    # serving stack (ISSUE 9): one serve cycle end-to-end — two CONCURRENT
+    # requests through the continuous-batching engine (prefill + decode
+    # over the paged KV-cache, int8-quantized weights) with the serve/*
+    # JSONL fields populated, the compression ratio >= 3.5x asserted, and
+    # every KV block back in the pool after the drain
+    import jax as _jx
+
+    from stoke_tpu import ServeConfig
+    from stoke_tpu.models.gpt import GPT
+    from stoke_tpu.utils import init_module
+
+    sv_dir = os.path.join(out_dir, "serve")
+    sv_model = GPT(
+        vocab_size=211, size_name="tiny", max_len=128, dropout_rate=0.0
+    )
+    sv_vars = init_module(
+        sv_model, _jx.random.PRNGKey(0), np.zeros((1, 8), np.int32),
+        train=False,
+    )
+    sv = Stoke(
+        model=sv_model,
+        optimizer=StokeOptimizer(
+            optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.1}
+        ),
+        loss=lambda o, y: 0.0,
+        params=sv_vars,
+        batch_size_per_device=1,
+        model_train_kwargs={"train": True},
+        model_eval_kwargs={"train": False},
+        configs=[
+            TelemetryConfig(
+                output_dir=sv_dir, log_every_n_steps=1, prometheus=True,
+                tensorboard=False, sample_device_time=False, track_hbm=False,
+            ),
+            ServeConfig(
+                max_seqs=2, kv_block_size=8, max_seq_len=64,
+                max_new_tokens=4, prefill_pad_multiple=16,
+                quant="int8", quant_min_size=256,
+            ),
+        ],
+        verbose=False,
+    )
+    sv_eng = sv.serve()
+    sv_r = np.random.default_rng(0)
+    sv_rids = [
+        sv_eng.submit(sv_r.integers(1, 211, size=7).astype(np.int32), 4)
+        for _ in range(2)
+    ]
+    sv_eng.run()
+    sv.close_telemetry()
+    sv_rec = read_step_events(os.path.join(sv_dir, "steps.jsonl"))[-1]
+    sv_prom = open(os.path.join(sv_dir, "metrics.prom")).read()
+    serving_ok = (
+        all(
+            len(sv_eng.scheduler.finished[rid].tokens) == 4
+            for rid in sv_rids
+        )
+        and sv_rec.get("serve/completed") == 2.0
+        and sv_rec.get("serve/ttft_p50_s") is not None
+        and sv_rec.get("serve/tpot_p50_s") is not None
+        and (sv_rec.get("serve/quant_compression") or 0) >= 3.5
+        and sv_rec.get("serve/kv_block_occupancy") == 0.0
+        and sv_eng.allocator.used_blocks == 0
+        and "stoke_serve_ttft_s" in sv_prom
+        and "stoke_serve_kv_block_occupancy" in sv_prom
+    )
+
     records = read_step_events(os.path.join(out_dir, "steps.jsonl"))
     print(json.dumps(records[-1], sort_keys=True))
     rec = records[-1]
@@ -283,6 +353,10 @@ def main() -> int:
         and compile_cache_ok
         and resilience_ok
         and zero_ok
+        and serving_ok
+        # default-OFF discipline (ISSUE 9): training records never carry
+        # serve fields
+        and not any(k.startswith("serve/") for k in rec)
     )
     print(json.dumps({
         "telemetry_smoke": "ok" if ok else "FAILED",
@@ -306,6 +380,10 @@ def main() -> int:
         "zero_sharded_step": "ok" if zero_ok else "FAILED",
         "zero_comm_compression": zero_rec.get("comm_compression"),
         "zero_param_gather_bytes": zero_rec.get("comm_bytes_param_gather"),
+        "serve_cycle": "ok" if serving_ok else "FAILED",
+        "serve_ttft_p50_s": sv_rec.get("serve/ttft_p50_s"),
+        "serve_tpot_p50_s": sv_rec.get("serve/tpot_p50_s"),
+        "serve_quant_compression": sv_rec.get("serve/quant_compression"),
     }))
     return 0 if ok else 1
 

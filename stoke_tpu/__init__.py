@@ -35,6 +35,7 @@ from stoke_tpu.configs import (
     ProfilerConfig,
     ResilienceConfig,
     SDDPConfig,
+    ServeConfig,
     TelemetryConfig,
     TensorboardConfig,
     ShardingOptions,
@@ -104,6 +105,7 @@ __all__ = [
     "CheckpointConfig",
     "ProfilerConfig",
     "ResilienceConfig",
+    "ServeConfig",
     "TelemetryConfig",
     "TensorboardConfig",
     # adapters

@@ -1118,6 +1118,111 @@ class CompileConfig:
     min_compile_time_s: float = 0.0
 
 
+#: prefill attention kernels ServeConfig accepts (validated by status.py)
+SERVE_ATTENTION_KERNELS: Tuple[str, ...] = ("dense", "flash")
+#: weight-quantization modes ServeConfig accepts ("none" = serve at the
+#: params' native dtype)
+SERVE_QUANT_MODES: Tuple[str, ...] = ("none", "bf16", "int8")
+#: KV-cache storage dtypes ServeConfig accepts
+SERVE_KV_DTYPES: Tuple[str, ...] = ("float32", "bfloat16")
+
+
+@dataclass
+class ServeConfig:
+    """Continuous-batching inference engine (ISSUE 9 tentpole): paged
+    KV-cache, prefill/decode split, int8/bf16 weight quantization, and
+    per-request TTFT/TPOT telemetry behind ``Stoke.serve()``.
+
+    No reference equivalent (the reference is training-only; SURVEY.md has
+    no inference story).  TPU serving economics hinge on exactly the pieces
+    the training side already built — a fused attention kernel, aggressive
+    batching, low-precision weights, and compile-and-cache discipline
+    (arXiv:2605.25645, the Gemma-on-TPU serving comparison) — so the
+    serving vertical reuses them: the flash kernel prefills, the PR-2
+    stochastic-rounding quantizer (``parallel/collectives.py``) shrinks
+    weights, the PR-6 AOT ledger warm-starts the prefill/decode programs,
+    and the PR-1 registry carries the latency histograms.
+
+    Default OFF — a ``ServeConfig`` in ``Stoke(configs=[...])`` changes
+    NOTHING about the training paths (it is only read by
+    ``Stoke.serve()``): training step-program HLO and dispatch counts are
+    bit-identical with it absent vs present, and the ``serve/*`` telemetry
+    fields never appear in a training run's JSONL.
+
+    Four pillars (docs/serving.md has the full architecture):
+
+    1. **Paged KV-cache** (``serving/kv_cache.py``): a block-pool cache of
+       ``kv_blocks`` blocks × ``kv_block_size`` tokens, per-request block
+       tables, addressed by the decode-mode attention variant
+       (``ops.flash_attention.paged_decode_attention``).  Block 0 is a
+       reserved scratch block (inactive slots write there; nothing reads
+       it).
+    2. **Continuous batching** (``serving/scheduler.py``): requests admit
+       mid-flight into ``max_seqs`` fixed slots, finished sequences evict
+       and their blocks refill the pool, so decode steps always run the
+       full slot batch.
+    3. **Prefill/decode split**: prompts prefill one request at a time
+       (padded to ``prefill_pad_multiple`` buckets — the compiled-program
+       count stays bounded) through the configured ``attention`` kernel;
+       decode runs single-token cache-read steps.  Both programs register
+       with the PR-6 compile-cache program ledger when a ``CompileConfig``
+       is present.
+    4. **Weight quantization** (``serving/quant.py``): ``quant="int8"``
+       stores matmul weights as int8 + one f32 scale per
+       ``quant_chunk_elems`` chunk (PR-2 ``quantize_chunks``), dequantized
+       matmul-side inside the compiled programs — ~3.9× less HBM per
+       replica; ``"bf16"`` halves instead.
+
+    Attributes:
+        max_seqs: decode slot count (the continuous-batching batch size;
+            every decode step runs this fixed shape).
+        kv_block_size: tokens per KV block.
+        kv_blocks: total blocks in the pool, INCLUDING the reserved
+            scratch block 0.  ``None`` auto-sizes to fit ``max_seqs``
+            full-length sequences (+ scratch).
+        max_seq_len: per-request prompt+output cap (must fit the model's
+            ``max_len``; checked at ``serve()`` time).
+        max_new_tokens: default per-request generation cap (requests may
+            pass their own).
+        prefill_pad_multiple: prompts are padded up to a multiple of this
+            before prefill — each padded length is one compiled program,
+            so this bounds program count (the "chunking" knob).
+        attention: prefill kernel — "dense" (causal bias in fp32 softmax)
+            or "flash" (the Pallas kernel, ``causal=True``; interpreted
+            off-TPU).  Decode always reads the paged cache.
+        kv_dtype: KV-cache storage dtype ("float32" for exact parity,
+            "bfloat16" to halve cache HBM).
+        quant: weight quantization mode ("none" | "bf16" | "int8").
+        quant_chunk_elems: elements sharing one f32 scale in int8 mode
+            (the PR-2 wire format; 128 ≈ 3.88× compression).
+        quant_stochastic: unbiased stochastic rounding for int8 weights
+            (the PR-2 machinery; default False = deterministic
+            round-to-nearest — lower error for a one-shot weight cast).
+        quant_min_size: leaves with fewer elements stay unquantized
+            (biases/layernorms: quantizing them saves nothing and costs
+            accuracy).
+        eos_id: token id that finishes a request early (None = run to the
+            token cap).
+        log_every_n_steps: engine iterations between serve telemetry
+            records (JSONL ``serve/*`` fields + gauge refresh).
+    """
+
+    max_seqs: int = 8
+    kv_block_size: int = 16
+    kv_blocks: Optional[int] = None
+    max_seq_len: int = 512
+    max_new_tokens: int = 64
+    prefill_pad_multiple: int = 64
+    attention: str = "dense"
+    kv_dtype: str = "float32"
+    quant: str = "none"
+    quant_chunk_elems: int = 128
+    quant_stochastic: bool = False
+    quant_min_size: int = 1024
+    eos_id: Optional[int] = None
+    log_every_n_steps: int = 8
+
+
 @dataclass
 class ProfilerConfig:
     """First-class profiling (SURVEY.md §5: native win over the reference's
@@ -1180,6 +1285,7 @@ ALL_CONFIG_CLASSES: Tuple[type, ...] = (
     HealthConfig,
     ProfilerConfig,
     ResilienceConfig,
+    ServeConfig,
     TelemetryConfig,
     TensorboardConfig,
 )

@@ -2433,6 +2433,77 @@ class Stoke:
         )
 
     # ------------------------------------------------------------------ #
+    # serving (ISSUE 9: continuous-batching inference behind the facade)
+    # ------------------------------------------------------------------ #
+
+    def serve(self, **overrides):
+        """Build the continuous-batching inference engine over this run's
+        model + current params (ISSUE 9 tentpole entry point).
+
+        Requires a :class:`~stoke_tpu.configs.ServeConfig` in
+        ``Stoke(configs=[...])`` and a :class:`~stoke_tpu.models.gpt.GPT`
+        model — serving is the paged-KV decode path models/gpt.py grew;
+        ``overrides`` are ``ServeConfig`` field replacements applied for
+        this engine only (e.g. ``stoke.serve(max_seqs=16)``).
+
+        The engine inherits this facade's plumbing: the telemetry
+        pipeline (``serve/*`` JSONL fields + Prometheus gauges land in
+        the same sinks), and — with a ``CompileConfig`` — the PR-6
+        AOT program ledger, so prefill/decode warm-start like the step
+        programs do.  The config's presence alone changes NOTHING about
+        training (it is only read here; tests assert step-program HLO
+        bit-identity).
+
+        Params note: the engine reads the facade's LIVE params.  The
+        int8/bf16 quantized stores copy into their own (smaller) buffers;
+        ``quant="none"`` ALIASES the training params — build the engine
+        after training finishes, and rebuild it (``serve()`` again) if
+        you train further, since the step programs donate those buffers.
+        """
+        import dataclasses as _dc
+
+        from stoke_tpu.models.gpt import GPT
+        from stoke_tpu.serving import ServingEngine
+        from stoke_tpu.status import StokeValidationError
+
+        scfg = self._status_obj.serve_config
+        if scfg is None:
+            raise StokeValidationError(
+                "Stoke.serve() requires a ServeConfig — add one to "
+                "Stoke(configs=[ServeConfig(...)]) (the serving stack is "
+                "opt-in; docs/serving.md)"
+            )
+        if overrides:
+            scfg = _dc.replace(scfg, **overrides)
+            # replaced fields re-validate through the same status rules a
+            # constructor-supplied config passes
+            StokeStatus(
+                batch_size_per_device=self._status_obj.batch_size,
+                configs=[scfg],
+            )
+        module = getattr(self._adapter, "module", None)
+        if not isinstance(module, GPT):
+            raise TypeError(
+                f"Stoke.serve() serves GPT models (the paged-KV decode "
+                f"forward lives in models/gpt.py); this facade wraps "
+                f"{type(module or self._adapter).__name__}"
+            )
+        kv_sharding = None
+        if self._mesh is not None:
+            # replicated pool on the mesh: each data-parallel serving
+            # replica owns a full cache (model-sharded pools are a
+            # placement change in PagedKVCache, not an engine change)
+            kv_sharding = NamedSharding(self._mesh, P())
+        return ServingEngine(
+            module,
+            self.params,
+            scfg,
+            telemetry=self._telemetry,
+            compile_cache=self._compile_cache,
+            kv_sharding=kv_sharding,
+        )
+
+    # ------------------------------------------------------------------ #
     # save / load (reference stoke.py:1060-1142)
     # ------------------------------------------------------------------ #
 

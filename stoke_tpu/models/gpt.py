@@ -63,16 +63,51 @@ class GPT(nn.Module):
     chunked_head: bool = False
 
     @nn.compact
-    def __call__(self, input_ids, train: bool = True, positions=None):
+    def __call__(self, input_ids, train: bool = True, positions=None,
+                 decode: bool = False, kv_cache=None):
         """``positions`` (optional [L] or [B, L] int) overrides the default
         ``arange`` position ids — required when the sequence is laid out in
         a non-natural order (the zigzag layout of
         ``ops.zigzag_ring_attention``, packed sequences): the position
         embedding must follow each token's ORIGINAL position.  The causal
         attention mask is the attention_fn's job in that case
-        (``attention_is_causal=True``)."""
+        (``attention_is_causal=True``).
+
+        Serving path (ISSUE 9): ``kv_cache`` is a per-trace paged-cache
+        hook (``stoke_tpu.serving.kv_cache.PagedAttentionHook``) supplying
+        one attention fn per layer via ``layer_attention(i)`` — each
+        writes that layer's fresh K/V into the block pool and (in decode
+        mode) attends over the gathered cached blocks.  ``decode=True``
+        marks the incremental single-token forward: ``input_ids`` is
+        ``[B, 1]``, ``positions`` carries each slot's current position,
+        and the hook's updated page arrays are read back by the caller
+        after apply (the hook threads them functionally through one
+        trace).  Incremental decode matches the full-sequence forward
+        token-for-token (tests/test_serving.py decode-parity).  The
+        causal mask is the hook's job, so no in-model bias is built."""
         size: BertSize = BERT_SIZES[self.size_name]
         B, L = input_ids.shape
+        if decode and kv_cache is None:
+            raise ValueError(
+                "GPT: decode=True needs a kv_cache hook — the incremental "
+                "forward reads/writes the paged KV-cache "
+                "(stoke_tpu.serving.kv_cache.PagedAttentionHook)"
+            )
+        if decode and L != 1:
+            raise ValueError(
+                f"GPT: decode=True is single-token incremental decode; got "
+                f"sequence length {L} (prefill runs kv_cache without decode)"
+            )
+        if decode and positions is None:
+            raise ValueError(
+                "GPT: decode=True needs explicit positions (each slot's "
+                "current cache position selects its position embedding)"
+            )
+        if kv_cache is not None and self.moe_num_experts > 0:
+            raise NotImplementedError(
+                "GPT: the paged KV-cache serving path supports dense FFN "
+                "blocks only (no MoE routing state in the cache)"
+            )
         if L > self.max_len:
             # XLA would silently clamp out-of-range position indices,
             # collapsing every position past max_len onto one embedding
@@ -100,7 +135,10 @@ class GPT(nn.Module):
                 pos = pos[None, :]
         h = h + nn.Embed(self.max_len, size.hidden, name="pos_emb")(pos)
         h = nn.Dropout(self.dropout_rate)(h, deterministic=not train)
-        if self.attention_is_causal:
+        if self.attention_is_causal or kv_cache is not None:
+            # cache-aware attention (serving): masking — causal + prompt
+            # padding in prefill, context-length in decode — is the
+            # kv_cache hook's job, exactly like a causal attention_fn's
             bias = None
         else:
             causal = jnp.tril(jnp.ones((L, L), bool))
@@ -137,9 +175,18 @@ class GPT(nn.Module):
                     self.moe_top_k, name=f"layer_{i}",
                 )(h, bias, not train)
             else:
+                # cache-aware serving: each layer gets its OWN attention fn
+                # from the hook (it addresses that layer's page plane) —
+                # attention_fn is not a parameter, so the param tree is
+                # identical to the training forward's
+                attn_fn = (
+                    self.attention_fn
+                    if kv_cache is None
+                    else kv_cache.layer_attention(i)
+                )
                 h = block(
                     size.hidden, size.heads, size.ff, self.dropout_rate,
-                    self.attention_fn, name=f"layer_{i}",
+                    attn_fn, name=f"layer_{i}",
                 )(h, bias, not train)
         h = nn.LayerNorm(epsilon=1e-5, name="ln_final")(h)
         if self.chunked_head:

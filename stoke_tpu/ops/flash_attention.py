@@ -505,6 +505,56 @@ def dense_reference(q, k, v, mask=None, causal=False):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
 
 
+def paged_decode_attention(q, k_pages, v_pages, block_tables, context_lens):
+    """Decode-mode attention over a paged KV-cache (ISSUE 9 serving path).
+
+    Single-token decode is HBM-bandwidth-bound, not MXU-bound: each query
+    attends over its own sequence's cached K/V, which lives scattered
+    across a block pool addressed by a per-request block table (the
+    vLLM-style layout, sized so freed blocks refill mid-flight —
+    ``stoke_tpu.serving.kv_cache``).  The kernel gathers each request's
+    blocks from the pool and runs the same fp32 masked softmax the dense
+    reference uses — the flash recurrence degenerates at q-length 1 (one
+    online-softmax row), so the gather IS the whole memory schedule and
+    XLA lowers it to per-block dynamic slices out of HBM
+    (pallas_guide.md: KV caches live in HBM; a dedicated Pallas decode
+    kernel streaming blocks through VMEM is the TPU follow-up, the math
+    below is its reference semantics).
+
+    Args:
+        q: ``[B, H, 1, D]`` current-token queries (one per decode slot).
+        k_pages / v_pages: ``[NB, BS, H, D]`` block pool for ONE layer
+            (NB blocks of BS tokens).
+        block_tables: ``[B, MAX_BLOCKS] int32`` — each slot's block ids
+            into the pool, in sequence order; unused entries may point
+            anywhere (the reserved scratch block 0 by convention) — they
+            are masked by ``context_lens``.
+        context_lens: ``[B] int32`` — valid tokens per slot INCLUDING the
+            current one (positions ``>= context_lens[b]`` are masked).
+
+    Returns ``[B, H, 1, D]`` attention outputs in the query dtype.
+    """
+    B, H, one, D = q.shape
+    if one != 1:
+        raise ValueError(
+            f"paged_decode_attention is single-token decode; got q-length "
+            f"{one} (prefill goes through flash_attention/dense_attention)"
+        )
+    NB, BS = k_pages.shape[0], k_pages.shape[1]
+    # gather each slot's window: [B, MAX_BLOCKS, BS, H, D] -> [B, W, H, D]
+    k = jnp.take(k_pages, block_tables, axis=0).reshape(B, -1, H, D)
+    v = jnp.take(v_pages, block_tables, axis=0).reshape(B, -1, H, D)
+    s = jnp.einsum(
+        "bhqd,bwhd->bhqw", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) / (D**0.5)
+    w_pos = jnp.arange(k.shape[1], dtype=jnp.int32)
+    valid = w_pos[None, :] < context_lens[:, None]  # [B, W]
+    s = jnp.where(valid[:, None, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqw,bwhd->bhqd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
 def make_flash_attention(
     causal: bool = False, block_q: Optional[int] = None,
     block_k: Optional[int] = None, interpret: Optional[bool] = None,

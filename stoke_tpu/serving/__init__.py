@@ -1,0 +1,50 @@
+"""Serving stack (ISSUE 9): continuous-batching inference with paged KV.
+
+The inference vertical behind ``Stoke.serve()``:
+
+- :mod:`~stoke_tpu.serving.kv_cache` — block-pool paged KV-cache, the
+  per-request block tables, and the GPT attention hook;
+- :mod:`~stoke_tpu.serving.scheduler` — continuous batching (mid-flight
+  admission, eviction, block refill) over the native request packer;
+- :mod:`~stoke_tpu.serving.quant` — int8/bf16 weight store reusing the
+  PR-2 stochastic-rounding quantizer, matmul-side dequant;
+- :mod:`~stoke_tpu.serving.telemetry` — TTFT/TPOT histograms + p50/p99
+  gauges, capacity gauges, queue/prefill/decode goodput buckets;
+- :mod:`~stoke_tpu.serving.engine` — the prefill/decode-split engine
+  wiring it all to the compiled programs and the PR-6 AOT ledger.
+
+See docs/serving.md for the architecture tour and sizing guidance.
+"""
+
+from stoke_tpu.serving.engine import ServingEngine
+from stoke_tpu.serving.kv_cache import (
+    SCRATCH_BLOCK,
+    BlockAllocator,
+    PagedAttentionHook,
+    PagedKVCache,
+)
+from stoke_tpu.serving.quant import (
+    QuantizedTensor,
+    compression_stats,
+    dequantize_params,
+    param_bytes,
+    quantize_params,
+)
+from stoke_tpu.serving.scheduler import Request, Scheduler
+from stoke_tpu.serving.telemetry import ServeMetrics
+
+__all__ = [
+    "ServingEngine",
+    "PagedKVCache",
+    "PagedAttentionHook",
+    "BlockAllocator",
+    "SCRATCH_BLOCK",
+    "Scheduler",
+    "Request",
+    "ServeMetrics",
+    "QuantizedTensor",
+    "quantize_params",
+    "dequantize_params",
+    "param_bytes",
+    "compression_stats",
+]

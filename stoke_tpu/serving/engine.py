@@ -1,0 +1,455 @@
+"""Continuous-batching serving engine: prefill/decode split over paged KV.
+
+ISSUE 9 pillar 3 and the piece that wires the other three together.  Two
+compiled programs, deliberately split (the Gemma-on-TPU comparison's
+serving shape, arXiv:2605.25645):
+
+- **prefill** — one request at a time, prompt padded up to the
+  ``prefill_pad_multiple`` bucket (each bucket is ONE compiled program, so
+  program count is bounded), causal attention through the configured
+  kernel (dense or the Pallas flash kernel), every prompt K/V written into
+  the request's blocks, and the first generated token sampled from the
+  last prompt position — the TTFT point.
+- **decode** — ALL ``max_seqs`` slots every step, single fresh token per
+  slot, cache-read attention over gathered blocks
+  (``ops.flash_attention.paged_decode_attention``).  Inactive slots run
+  against the scratch block and their outputs are discarded, so the
+  program shape never changes and XLA compiles it exactly once.
+
+Both programs register with the PR-6 compile-cache program ledger when a
+``CompileConfig`` is attached (``compile_cache.executable`` — warm starts
+load from the persistent XLA cache and book reclaimed seconds), dispatch
+through plain ``jax.jit`` (page buffers donated off-CPU, so cache updates
+are in-place in HBM), and read weights through the ISSUE 9 quantized
+store (``serving/quant.py``; dequant fused matmul-side by XLA).
+
+Sampling is greedy argmax — deterministic by design: the
+continuous-batching acceptance (staggered admission produces token
+streams identical to sequential generation) is only testable under a
+deterministic sampler, and the decode program's fixed batch shape makes
+per-slot results independent of co-batched requests.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from stoke_tpu.configs import ServeConfig
+from stoke_tpu.models.bert import BERT_SIZES
+from stoke_tpu.models.gpt import GPT
+from stoke_tpu.serving.kv_cache import (
+    BlockAllocator,
+    PagedAttentionHook,
+    PagedKVCache,
+)
+from stoke_tpu.serving.quant import (
+    compression_stats,
+    dequantize_params,
+    quantize_params,
+)
+from stoke_tpu.serving.scheduler import Request, Scheduler
+from stoke_tpu.serving.telemetry import ServeMetrics
+from stoke_tpu.telemetry.registry import MetricsRegistry
+
+_KV_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+class ServingEngine:
+    """Continuous-batching inference engine over one GPT model.
+
+    Built by :meth:`stoke_tpu.facade.Stoke.serve` (which supplies the
+    trained params, telemetry pipeline, and compile cache) or standalone
+    in tests/scripts.
+
+    Args:
+        model: a :class:`~stoke_tpu.models.gpt.GPT` module (dense FFN,
+            ``chunked_head=False``).
+        params: the model's ``params`` pytree (NOT the variables dict).
+        cfg: :class:`~stoke_tpu.configs.ServeConfig`.
+        registry: metrics registry for the ``serve/*`` instruments
+            (defaults to ``telemetry.registry`` or a private one).
+        telemetry: optional :class:`~stoke_tpu.telemetry.Telemetry` —
+            when enabled, serve records land in its JSONL/Prometheus
+            sinks with the ``serve/*`` field block.
+        compile_cache: optional PR-6 :class:`~stoke_tpu.compile_cache
+            .CompileCache` — prefill/decode programs register with its
+            HLO-keyed ledger for warm starts.
+        kv_sharding: optional sharding for the page pool (mesh-placed
+            serving; default = wherever ``jnp.zeros`` lands).
+    """
+
+    def __init__(
+        self,
+        model: GPT,
+        params: Any,
+        cfg: ServeConfig,
+        *,
+        registry: Optional[MetricsRegistry] = None,
+        telemetry=None,
+        compile_cache=None,
+        kv_sharding=None,
+    ):
+        if not isinstance(model, GPT):
+            raise TypeError(
+                f"ServingEngine serves GPT models; got {type(model).__name__} "
+                f"(the paged-cache decode forward lives in models/gpt.py)"
+            )
+        if model.chunked_head:
+            raise ValueError(
+                "ServingEngine needs logits from the forward; construct the "
+                "serving GPT with chunked_head=False (params are identical)"
+            )
+        if model.moe_num_experts > 0:
+            raise NotImplementedError(
+                "ServingEngine supports dense-FFN GPT only (no MoE)"
+            )
+        if cfg.max_seq_len > model.max_len:
+            raise ValueError(
+                f"ServeConfig.max_seq_len={cfg.max_seq_len} exceeds the "
+                f"model's max_len={model.max_len}"
+            )
+        if _round_up(cfg.max_seq_len, cfg.prefill_pad_multiple) > model.max_len:
+            raise ValueError(
+                f"prefill padding bucket round_up(max_seq_len="
+                f"{cfg.max_seq_len}, {cfg.prefill_pad_multiple}) exceeds the "
+                f"model's max_len={model.max_len} — a full-length prompt "
+                f"would pad past the position table; shrink max_seq_len or "
+                f"prefill_pad_multiple"
+            )
+        self.model = model
+        self.cfg = cfg
+        self._telemetry = telemetry
+        self._compile_cache = compile_cache
+        self.metrics = ServeMetrics(
+            registry
+            if registry is not None
+            else (
+                telemetry.registry
+                if telemetry is not None
+                else MetricsRegistry()
+            )
+        )
+
+        size = BERT_SIZES[model.size_name]
+        self._heads = size.heads
+        self._head_dim = size.hidden // size.heads
+
+        # --- weight store (pillar 4): quantize once at load time ---
+        self.qparams = quantize_params(
+            params,
+            cfg.quant,
+            chunk_elems=cfg.quant_chunk_elems,
+            stochastic=cfg.quant_stochastic,
+            min_size=cfg.quant_min_size,
+        )
+        self.quant_stats = compression_stats(params, self.qparams)
+        self.metrics.quant_compression.set(self.quant_stats["compression"])
+
+        # --- paged KV pool (pillar 1) ---
+        max_blocks_per_seq = -(-cfg.max_seq_len // cfg.kv_block_size)
+        num_blocks = (
+            cfg.kv_blocks
+            if cfg.kv_blocks is not None
+            else cfg.max_seqs * max_blocks_per_seq + 1  # +1 scratch
+        )
+        self.cache = PagedKVCache(
+            size.num_layers,
+            num_blocks,
+            cfg.kv_block_size,
+            self._heads,
+            self._head_dim,
+            dtype=_KV_DTYPES[cfg.kv_dtype],
+            sharding=kv_sharding,
+        )
+        self.allocator = BlockAllocator(num_blocks, cfg.kv_block_size)
+
+        # --- continuous-batching scheduler (pillar 2) ---
+        self.scheduler = Scheduler(
+            cfg.max_seqs,
+            self.allocator,
+            max_blocks_per_seq,
+            max_seq_len=cfg.max_seq_len,
+            default_max_new_tokens=cfg.max_new_tokens,
+            eos_id=cfg.eos_id,
+            pad_multiple=cfg.prefill_pad_multiple,
+        )
+
+        # --- compiled programs (pillar 3) ---
+        # donation keeps the page pool in-place in HBM; the CPU backend
+        # has no donation (jax warns and copies), so only donate off-CPU
+        donate = (1, 2) if jax.default_backend() != "cpu" else ()
+        self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=donate)
+        self._decode_jit = jax.jit(self._decode_fn, donate_argnums=donate)
+
+        self._iterations = 0
+        self._last_emit_iter = 0
+        self._t_start = time.perf_counter()
+
+    # ------------------------------------------------------------------ #
+    # compiled program bodies
+    # ------------------------------------------------------------------ #
+
+    def _apply(self, params, tokens, positions, hook, decode: bool):
+        return self.model.apply(
+            {"params": params},
+            tokens,
+            train=False,
+            positions=positions,
+            decode=decode,
+            kv_cache=hook,
+        )
+
+    def _prefill_fn(self, qparams, k_pages, v_pages, tokens, block_row,
+                    prompt_len):
+        """tokens [1, P] padded prompt; block_row [1, MB]; prompt_len [1].
+        Returns (first generated token [1], updated pages)."""
+        params = dequantize_params(qparams)
+        P = tokens.shape[1]
+        positions = jnp.arange(P, dtype=jnp.int32)[None, :]
+        hook = PagedAttentionHook(
+            k_pages, v_pages, block_row, positions,
+            mode="prefill", lengths=prompt_len,
+            attention_impl=self.cfg.attention,
+        )
+        logits = self._apply(params, tokens, positions, hook, decode=False)
+        last = logits[0, prompt_len[0] - 1]
+        return (
+            jnp.argmax(last, axis=-1).astype(jnp.int32)[None],
+            hook.k_pages,
+            hook.v_pages,
+        )
+
+    def _decode_fn(self, qparams, k_pages, v_pages, tokens, positions,
+                   block_tables, context_lens):
+        """tokens/positions [B]; block_tables [B, MB]; context_lens [B].
+        Returns (next tokens [B], updated pages)."""
+        params = dequantize_params(qparams)
+        hook = PagedAttentionHook(
+            k_pages, v_pages, block_tables, positions[:, None],
+            mode="decode", lengths=context_lens,
+            attention_impl=self.cfg.attention,
+        )
+        logits = self._apply(
+            params, tokens[:, None], positions[:, None], hook, decode=True
+        )
+        return (
+            jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32),
+            hook.k_pages,
+            hook.v_pages,
+        )
+
+    # ------------------------------------------------------------------ #
+    # program-signature dispatch (PR-6 AOT ledger registration)
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def _sig(args) -> tuple:
+        return tuple(
+            (tuple(l.shape), str(getattr(l, "dtype", "")))
+            for l in jax.tree_util.tree_leaves(args)
+            if hasattr(l, "shape")
+        )
+
+    def _dispatch(self, program: str, fn, args: tuple):
+        """Route one dispatch through the compile cache's program ledger
+        (same contract as ``StepEngine._aot_call``): first dispatch per
+        (program, shape signature) checks the HLO-keyed ledger — warm
+        starts resolve to an already-built fn and book reclaimed compile
+        seconds — and every dispatch runs plain ``jax.jit`` semantics."""
+        cc = self._compile_cache
+        if cc is not None:
+            fn = cc.executable(program, (program, self._sig(args)), fn, args)
+        return fn(*args)
+
+    # ------------------------------------------------------------------ #
+    # request intake
+    # ------------------------------------------------------------------ #
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: Optional[int] = None,
+        eos_id: Optional[int] = None,
+    ) -> int:
+        """Enqueue one request (mid-flight is the point); returns its id."""
+        rid = self.scheduler.submit(prompt, max_new_tokens, eos_id)
+        self.metrics.requests.inc()
+        return rid
+
+    def result(self, rid: int) -> Optional[Request]:
+        return self.scheduler.finished.get(rid)
+
+    # ------------------------------------------------------------------ #
+    # the engine loop
+    # ------------------------------------------------------------------ #
+
+    def step(self) -> bool:
+        """One engine iteration: admit + prefill arrivals, then one decode
+        step over the full slot batch.  Returns True while work remains."""
+        sched = self.scheduler
+        m = self.metrics
+
+        for slot, req, padded, plen in sched.admit():
+            t0 = time.perf_counter()
+            tok, k_pages, v_pages = self._dispatch(
+                "serve_prefill",
+                self._prefill_jit,
+                (
+                    self.qparams,
+                    self.cache.k_pages,
+                    self.cache.v_pages,
+                    jnp.asarray(padded),
+                    jnp.asarray(sched.block_tables[slot : slot + 1]),
+                    jnp.array([plen], jnp.int32),
+                ),
+            )
+            self.cache.k_pages, self.cache.v_pages = k_pages, v_pages
+            tok_host = int(np.asarray(tok)[0])  # sync: the TTFT point
+            now = time.perf_counter()
+            m.prefills.inc()
+            m.prefill_s.inc(now - t0)
+            sched.note_prefill_token(slot, tok_host, now)
+            m.tokens_out.inc()
+            m.observe_ttft(req.ttft_s)
+            if req.finished:
+                self._finish(req)
+
+        if sched.active > 0:
+            t0 = time.perf_counter()
+            tokens, positions, tables, context = sched.decode_batch()
+            next_tok, k_pages, v_pages = self._dispatch(
+                "serve_decode",
+                self._decode_jit,
+                (
+                    self.qparams,
+                    self.cache.k_pages,
+                    self.cache.v_pages,
+                    jnp.asarray(tokens),
+                    jnp.asarray(positions),
+                    jnp.asarray(tables),
+                    jnp.asarray(context),
+                ),
+            )
+            self.cache.k_pages, self.cache.v_pages = k_pages, v_pages
+            next_host = np.asarray(next_tok)  # sync: tokens stream out
+            now = time.perf_counter()
+            m.decode_steps.inc()
+            m.decode_s.inc(now - t0)
+            was_finished = set(sched.finished)
+            live = sched.commit_decode(next_host, now)
+            m.tokens_out.inc(live)
+            for rid in set(sched.finished) - was_finished:
+                self._finish(sched.finished[rid])
+
+        self._iterations += 1
+        self._refresh_gauges()
+        if (
+            self._iterations - self._last_emit_iter
+            >= self.cfg.log_every_n_steps
+        ):
+            self.emit_record()
+        return sched.has_work
+
+    def run(self, max_steps: Optional[int] = None) -> int:
+        """Drive :meth:`step` until drained (or ``max_steps``); emits a
+        final telemetry record.  Returns iterations run."""
+        n = 0
+        while self.scheduler.has_work:
+            self.step()
+            n += 1
+            if max_steps is not None and n >= max_steps:
+                break
+        if self._iterations != self._last_emit_iter:
+            # final record on drain — unless the last step() just emitted
+            # at the cadence (a duplicate step key would confuse readers)
+            self.emit_record()
+        return n
+
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens: Optional[int] = None,
+    ) -> List[List[int]]:
+        """Convenience batch API: submit all, drain, return token lists in
+        prompt order (the continuous batcher still interleaves them)."""
+        rids = [self.submit(p, max_new_tokens) for p in prompts]
+        self.run()
+        return [list(self.scheduler.finished[r].tokens) for r in rids]
+
+    # ------------------------------------------------------------------ #
+    # accounting
+    # ------------------------------------------------------------------ #
+
+    def _finish(self, req: Request) -> None:
+        m = self.metrics
+        m.completed.inc()
+        tpot = req.tpot_s
+        if tpot is not None:
+            m.observe_tpot(tpot)
+        if self._telemetry is not None:
+            self._telemetry.add_tokens(len(req.tokens))
+
+    def _refresh_gauges(self) -> None:
+        m, sched = self.metrics, self.scheduler
+        m.queue_depth.set(sched.queued)
+        m.active_seqs.set(sched.active)
+        m.batch_fill.set(sched.batch_fill)
+        m.kv_blocks_used.set(self.allocator.used_blocks)
+        m.kv_occupancy.set(self.allocator.occupancy)
+        # sums-to-wall: queue/idle is the wall clock neither program used
+        wall = time.perf_counter() - self._t_start
+        target = max(
+            0.0, wall - m.prefill_s.value - m.decode_s.value
+        )
+        if target > m.queue_s.value:
+            m.queue_s.inc(target - m.queue_s.value)
+
+    def emit_record(self) -> Optional[dict]:
+        """Write one JSONL serve record through the telemetry pipeline
+        (None when no enabled Telemetry is attached; the registry gauges
+        update regardless)."""
+        self._refresh_gauges()
+        window = max(1, self._iterations - self._last_emit_iter)
+        self._last_emit_iter = self._iterations
+        if self._telemetry is None or not self._telemetry.enabled:
+            return None
+        return self._telemetry.record_step(
+            step=self._iterations,
+            window_steps=window,
+            serve=self.metrics.event_fields(),
+        )
+
+    # ------------------------------------------------------------------ #
+    # introspection
+    # ------------------------------------------------------------------ #
+
+    def summary(self) -> Dict[str, Any]:
+        m = self.metrics
+        m.refresh_percentiles()
+        return {
+            "iterations": self._iterations,
+            "requests": m.requests.value,
+            "completed": m.completed.value,
+            "tokens_out": m.tokens_out.value,
+            "prefills": m.prefills.value,
+            "decode_steps": m.decode_steps.value,
+            "kv_blocks_used": self.allocator.used_blocks,
+            "kv_block_occupancy": self.allocator.occupancy,
+            "quant": dict(self.quant_stats),
+            "kv_cache_bytes": self.cache.nbytes,
+            **m.latency_percentiles(),
+            "goodput_s": {
+                "queue": m.queue_s.value,
+                "prefill": m.prefill_s.value,
+                "decode": m.decode_s.value,
+            },
+        }

@@ -1,0 +1,279 @@
+"""Paged KV-cache: block pool + per-request block tables + attention hook.
+
+ISSUE 9 pillar 1.  Serving memory is dominated by the KV-cache, and naive
+per-request contiguous caches fragment HBM so badly that batch size — the
+thing TPU serving throughput actually scales with (arXiv:2605.25645) — is
+capped by the WORST-case sequence length.  The paged layout (vLLM lineage)
+fixes that: one pool of fixed-size blocks, per-request block tables mapping
+sequence position -> (block, offset), freed blocks refilling mid-flight as
+requests complete.
+
+Three pieces:
+
+- :class:`BlockAllocator` — host-side free list over the pool.  Block 0 is
+  RESERVED as a scratch block: inactive decode slots write their (discarded)
+  K/V there, so the compiled decode program always runs the full fixed-shape
+  slot batch with no active-mask branching.
+- :class:`PagedKVCache` — the device arrays: ``[n_layers, n_blocks,
+  block_size, heads, head_dim]`` K and V page planes, created zeroed on the
+  target device/mesh.  The serving engine threads them functionally through
+  its compiled programs (donated, so updates are in-place in HBM).
+- :class:`PagedAttentionHook` — the per-trace bridge into ``models/gpt.py``:
+  ``GPT(..., kv_cache=hook)`` asks it for one attention fn per layer.  In
+  prefill mode the fn writes the prompt's K/V into the slot's blocks and
+  runs ordinary causal attention (dense or the flash kernel) over the
+  prompt; in decode mode it writes the single fresh token's K/V and attends
+  over the gathered cached blocks
+  (:func:`stoke_tpu.ops.flash_attention.paged_decode_attention`).  The hook
+  carries the updated page arrays across layers within one trace; the
+  caller reads them back after ``apply`` and returns them from the jitted
+  program.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from stoke_tpu.models.bert import dense_attention
+from stoke_tpu.ops.flash_attention import (
+    flash_attention,
+    paged_decode_attention,
+)
+
+#: block id every unused block-table entry (and every inactive slot) points
+#: at — allocated to no request, read by nothing meaningful
+SCRATCH_BLOCK = 0
+
+
+class BlockAllocator:
+    """Host-side free list over the KV block pool (block 0 reserved).
+
+    Pure bookkeeping — never touches a device.  The scheduler allocates a
+    request's FULL worst-case block budget at admission (prompt + token
+    cap), so a mid-flight decode step can never fail on an empty pool;
+    freed blocks return to the tail and are reused by later admissions
+    (tests assert occupancy returns to 0 after drain).
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"BlockAllocator needs >= 2 blocks (one is the reserved "
+                f"scratch block {SCRATCH_BLOCK}), got {num_blocks}"
+            )
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._free: List[int] = list(range(1, num_blocks))
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks needed to hold ``n_tokens`` cache entries."""
+        return -(-max(int(n_tokens), 1) // self.block_size)
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        """Blocks currently owned by requests (scratch excluded)."""
+        return (self.num_blocks - 1) - len(self._free)
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable blocks (pool minus the scratch block)."""
+        return self.num_blocks - 1
+
+    @property
+    def occupancy(self) -> float:
+        """Fraction of the allocatable pool currently owned (the
+        ``serve/kv_block_occupancy`` gauge)."""
+        return self.used_blocks / max(self.capacity, 1)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Take ``n`` blocks, or None (allocator unchanged) when the pool
+        cannot supply them — the scheduler then keeps the request queued."""
+        if n > len(self._free):
+            return None
+        taken, self._free = self._free[:n], self._free[n:]
+        return taken
+
+    def free(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            if b == SCRATCH_BLOCK:
+                raise ValueError("cannot free the reserved scratch block")
+            if b in self._free:
+                raise ValueError(f"double free of KV block {b}")
+            self._free.append(int(b))
+
+
+class PagedKVCache:
+    """The device-side block pool: K and V page planes per layer.
+
+    Layout ``[n_layers, n_blocks, block_size, heads, head_dim]`` — layer
+    outermost so each layer's hook update is one static-index plane, block
+    next so a request's window gathers as per-block slices out of HBM.
+
+    ``sharding`` (optional ``jax.sharding.Sharding``) places the pool on
+    the serving mesh — replicated by default (data-parallel serving
+    replicas each own a full pool; a model-sharded pool over a heads axis
+    is a placement change here, not a layout change).
+    """
+
+    def __init__(
+        self,
+        n_layers: int,
+        num_blocks: int,
+        block_size: int,
+        heads: int,
+        head_dim: int,
+        dtype=jnp.float32,
+        sharding=None,
+    ):
+        self.n_layers = int(n_layers)
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.dtype = jnp.dtype(dtype)
+        shape = (n_layers, num_blocks, block_size, heads, head_dim)
+        k = jnp.zeros(shape, dtype)
+        v = jnp.zeros(shape, dtype)
+        if sharding is not None:
+            k = jax.device_put(k, sharding)
+            v = jax.device_put(v, sharding)
+        self.k_pages = k
+        self.v_pages = v
+
+    @property
+    def nbytes(self) -> int:
+        """HBM footprint of the pool (both planes)."""
+        return int(self.k_pages.size + self.v_pages.size) * self.dtype.itemsize
+
+
+def _flatten_heads(t):
+    """[B, H, L, D] attention layout -> [B*L, H, D] page-write layout."""
+    B, H, L, D = t.shape
+    return jnp.swapaxes(t, 1, 2).reshape(B * L, H, D)
+
+
+class PagedAttentionHook:
+    """Per-trace cache bridge for ``GPT(..., kv_cache=hook)``.
+
+    Constructed INSIDE the serving engine's jitted prefill/decode programs
+    around the (donated) page arrays; ``layer_attention(i)`` returns the
+    attention fn layer ``i``'s transformer block calls.  Page updates are
+    functional (``.at[].set``) and threaded through ``self.k_pages`` /
+    ``self.v_pages`` so the program returns the updated pool.
+
+    Args:
+        k_pages / v_pages: ``[n_layers, NB, BS, H, D]`` pool planes.
+        block_tables: ``[B, MAX_BLOCKS] int32`` per-slot block ids.
+        positions: ``[B, L] int32`` token positions being written this
+            call (prefill: ``arange`` rows; decode: each slot's current
+            position, L == 1).
+        mode: ``"prefill"`` or ``"decode"``.
+        lengths: ``[B] int32`` — prefill: true prompt lengths (padding
+            positions write to the scratch block and are masked); decode:
+            context lengths INCLUDING the fresh token.
+        attention_impl: prefill kernel, ``"dense"`` or ``"flash"``
+            (decode always reads the paged pool).
+    """
+
+    def __init__(
+        self,
+        k_pages,
+        v_pages,
+        block_tables,
+        positions,
+        *,
+        mode: str,
+        lengths,
+        attention_impl: str = "dense",
+    ):
+        if mode not in ("prefill", "decode"):
+            raise ValueError(f"unknown PagedAttentionHook mode {mode!r}")
+        self.k_pages = k_pages
+        self.v_pages = v_pages
+        self.block_tables = block_tables
+        self.positions = positions
+        self.mode = mode
+        self.lengths = lengths
+        self.attention_impl = attention_impl
+        self.block_size = int(k_pages.shape[2])
+
+    # ------------------------------ writes ----------------------------- #
+
+    def _write_layer(self, layer: int, k, v) -> None:
+        """Scatter this call's fresh K/V into layer ``layer``'s planes.
+
+        Valid (position < budget) tokens land at ``(block_table[b,
+        pos // BS], pos % BS)``; invalid ones — prompt padding, inactive
+        decode slots are steered by their all-scratch block tables — land
+        in the scratch block, which nothing reads.  Distinct live slots
+        own distinct blocks, so in-batch writes never collide.
+        """
+        B, L = self.positions.shape
+        pos = self.positions.reshape(-1)  # [B*L]
+        slot = jnp.repeat(jnp.arange(B, dtype=jnp.int32), L)
+        blk_idx = pos // self.block_size
+        if self.mode == "prefill":
+            valid = (
+                self.positions
+                < self.lengths[:, None].astype(self.positions.dtype)
+            ).reshape(-1)
+        else:
+            valid = jnp.ones_like(pos, dtype=bool)
+        # clamp the table column so padding positions past the allocated
+        # window index legally, then steer invalid writes to scratch
+        blk_idx = jnp.minimum(blk_idx, self.block_tables.shape[1] - 1)
+        blocks = self.block_tables[slot, blk_idx]
+        blocks = jnp.where(valid, blocks, SCRATCH_BLOCK)
+        offs = pos % self.block_size
+        kw = _flatten_heads(k).astype(self.k_pages.dtype)
+        vw = _flatten_heads(v).astype(self.v_pages.dtype)
+        self.k_pages = self.k_pages.at[layer, blocks, offs].set(kw)
+        self.v_pages = self.v_pages.at[layer, blocks, offs].set(vw)
+
+    # ----------------------------- attention --------------------------- #
+
+    def layer_attention(self, layer: int):
+        """The ``attention_fn`` (bert.py signature) for layer ``layer``."""
+
+        def attention_fn(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
+                         deterministic=True):
+            if dropout_rate > 0.0 and not deterministic:
+                raise NotImplementedError(
+                    "paged-cache attention is inference-only; attention "
+                    "dropout is not supported"
+                )
+            self._write_layer(layer, k, v)
+            if self.mode == "decode":
+                return paged_decode_attention(
+                    q,
+                    self.k_pages[layer],
+                    self.v_pages[layer],
+                    self.block_tables,
+                    self.lengths,
+                )
+            # prefill: ordinary causal attention over the (padded) prompt
+            # — the pages were just written for DECODE's benefit; the
+            # prompt itself is fully in registers/VMEM here, so the
+            # training-side kernels serve it unchanged
+            B, H, L, D = q.shape
+            key_valid = (
+                jnp.arange(L, dtype=jnp.int32)[None, :]
+                < self.lengths[:, None].astype(jnp.int32)
+            )  # [B, L]
+            if self.attention_impl == "flash":
+                return flash_attention(
+                    q, k, v, key_valid.astype(jnp.int32), causal=True
+                )
+            causal = jnp.tril(jnp.ones((L, L), bool))
+            allow = causal[None, None, :, :] & key_valid[:, None, None, :]
+            pbias = jnp.where(allow, 0.0, -1e9).astype(q.dtype)
+            return dense_attention(q, k, v, pbias)
+
+        return attention_fn

@@ -54,6 +54,10 @@ from stoke_tpu.configs import (
     ProfilerConfig,
     ResilienceConfig,
     SDDPConfig,
+    SERVE_ATTENTION_KERNELS,
+    SERVE_KV_DTYPES,
+    SERVE_QUANT_MODES,
+    ServeConfig,
     ShardingOptions,
     TelemetryConfig,
     TensorboardConfig,
@@ -732,6 +736,67 @@ class StokeStatus:
                 )
             return False
 
+        def _serve_invalid(s):
+            """Serving-stack legality (ISSUE 9): a ServeConfig that could
+            never admit a request, that names an unknown kernel/dtype/
+            quant mode, or whose block pool cannot hold even one
+            max-length sequence is rejected at construction — not at the
+            first ``serve()`` call mid-deployment.  The config is only
+            READ by ``Stoke.serve()``; its presence never touches the
+            training paths (default-OFF contract, tests/test_serving.py
+            asserts HLO bit-identity)."""
+            cfg = self._configs.get("ServeConfig")
+            if cfg is None:
+                return False
+            for field in ("max_seqs", "kv_block_size", "max_seq_len",
+                          "max_new_tokens", "prefill_pad_multiple",
+                          "log_every_n_steps"):
+                if getattr(cfg, field) < 1:
+                    return (
+                        f"ServeConfig.{field} must be >= 1, got "
+                        f"{getattr(cfg, field)}"
+                    )
+            if cfg.attention not in SERVE_ATTENTION_KERNELS:
+                return (
+                    f"ServeConfig.attention {cfg.attention!r} unknown; "
+                    f"valid: {list(SERVE_ATTENTION_KERNELS)}"
+                )
+            if cfg.quant not in SERVE_QUANT_MODES:
+                return (
+                    f"ServeConfig.quant {cfg.quant!r} unknown; valid: "
+                    f"{list(SERVE_QUANT_MODES)}"
+                )
+            if cfg.kv_dtype not in SERVE_KV_DTYPES:
+                return (
+                    f"ServeConfig.kv_dtype {cfg.kv_dtype!r} unknown; "
+                    f"valid: {list(SERVE_KV_DTYPES)}"
+                )
+            if cfg.quant_chunk_elems < 1:
+                return (
+                    f"ServeConfig.quant_chunk_elems must be >= 1, got "
+                    f"{cfg.quant_chunk_elems}"
+                )
+            if cfg.prefill_pad_multiple > cfg.max_seq_len:
+                return (
+                    f"ServeConfig.prefill_pad_multiple "
+                    f"{cfg.prefill_pad_multiple} exceeds max_seq_len "
+                    f"{cfg.max_seq_len} — every padded prompt would be "
+                    f"rejected"
+                )
+            if cfg.kv_blocks is not None:
+                # one max-length sequence needs ceil(max_seq_len/bs)
+                # blocks, plus the reserved scratch block 0
+                need = -(-cfg.max_seq_len // cfg.kv_block_size) + 1
+                if cfg.kv_blocks < need:
+                    return (
+                        f"ServeConfig.kv_blocks={cfg.kv_blocks} cannot "
+                        f"hold one max_seq_len={cfg.max_seq_len} sequence "
+                        f"(needs {need} blocks of {cfg.kv_block_size} "
+                        f"tokens incl. the reserved scratch block 0) — no "
+                        f"request could ever be admitted"
+                    )
+            return False
+
         def _offload_cpu_no_fallback(s):
             for name in ("OffloadOptimizerConfig", "OffloadParamsConfig"):
                 cfg = self._configs.get(name)
@@ -876,6 +941,10 @@ class StokeStatus:
             (
                 _compile_invalid,
                 "CompileConfig is invalid",
+            ),
+            (
+                _serve_invalid,
+                "ServeConfig is invalid",
             ),
             (
                 _offload_cpu_no_fallback,
@@ -1122,6 +1191,13 @@ class StokeStatus:
         cache is opt-in; without it the engine dispatches its jit
         programs exactly as before — bit-identical HLO)."""
         return self._configs.get("CompileConfig")
+
+    @property
+    def serve_config(self) -> Optional[ServeConfig]:
+        """None unless explicitly supplied (the serving stack is opt-in
+        and only read by ``Stoke.serve()``; without — or even with — the
+        config the training step paths are bit-identical to pre-ISSUE-9)."""
+        return self._configs.get("ServeConfig")
 
     @property
     def telemetry_config(self) -> Optional[TelemetryConfig]:

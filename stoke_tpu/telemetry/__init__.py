@@ -374,6 +374,7 @@ class Telemetry:
         health_anomalies: Optional[float] = None,
         tokens_hint: Optional[float] = None,
         ts: Optional[float] = None,
+        serve: Optional[Dict[str, Any]] = None,
     ) -> Optional[dict]:
         """Assemble one structured step event from the registry state and
         fan it to every sink.  Called by the facade at the logging cadence;
@@ -523,6 +524,9 @@ class Telemetry:
             hbm_bytes_limit=(hbm or {}).get("bytes_limit"),
             fleet=fleet_fields,
             resilience=resilience_fields,
+            # serving fields (ISSUE 9): only a ServingEngine emit passes
+            # them — training records stay free of every serve/* key
+            serve=serve,
             **attr_fields,
         )
         snapshot = self.registry.snapshot()

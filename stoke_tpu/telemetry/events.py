@@ -153,6 +153,29 @@ STEP_EVENT_FIELDS: Dict[str, tuple] = {
     "resilience/restarts": (False, "nullable_number"),
     "resilience/resumed_step": (False, "nullable_number"),
     "resilience/lost_steps": (False, "nullable_number"),
+    # serving engine (ISSUE 9; keys absent without a ServingEngine emit —
+    # training records NEVER carry them): cumulative request/token
+    # counters, capacity gauges (queue depth, decode-slot fill, KV-block
+    # occupancy), exact p50/p99 of the TTFT/TPOT reservoirs, the
+    # queue/prefill/decode goodput split of the serve wall clock
+    # (sums-to-wall, like the training goodput ledger), and the weight-
+    # quantization compression ratio (param bytes fp / as-served)
+    "serve/requests": (False, "nullable_number"),
+    "serve/completed": (False, "nullable_number"),
+    "serve/tokens_out": (False, "nullable_number"),
+    "serve/queue_depth": (False, "nullable_number"),
+    "serve/active_seqs": (False, "nullable_number"),
+    "serve/batch_fill": (False, "nullable_number"),
+    "serve/kv_blocks_used": (False, "nullable_number"),
+    "serve/kv_block_occupancy": (False, "nullable_number"),
+    "serve/ttft_p50_s": (False, "nullable_number"),
+    "serve/ttft_p99_s": (False, "nullable_number"),
+    "serve/tpot_p50_s": (False, "nullable_number"),
+    "serve/tpot_p99_s": (False, "nullable_number"),
+    "serve/goodput_queue_s": (False, "nullable_number"),
+    "serve/goodput_prefill_s": (False, "nullable_number"),
+    "serve/goodput_decode_s": (False, "nullable_number"),
+    "serve/quant_compression": (False, "nullable_number"),
     "hbm_bytes_in_use": (False, "nullable_number"),
     "hbm_peak_bytes": (False, "nullable_number"),
     "hbm_bytes_limit": (False, "nullable_number"),
@@ -168,6 +191,12 @@ FLEET_STEP_FIELDS = tuple(
 #: ``resilience=`` dict; ResilienceMonitor.event_fields must match)
 RESILIENCE_STEP_FIELDS = tuple(
     f for f in STEP_EVENT_FIELDS if f.startswith("resilience/")
+)
+
+#: the serving subset of the schema (populated via ``build_step_event``'s
+#: ``serve=`` dict; ServeMetrics.event_fields must match)
+SERVE_STEP_FIELDS = tuple(
+    f for f in STEP_EVENT_FIELDS if f.startswith("serve/")
 )
 
 
@@ -294,6 +323,7 @@ def build_step_event(
     hbm_bytes_limit: Optional[int] = None,
     fleet: Optional[Dict[str, Any]] = None,
     resilience: Optional[Dict[str, Any]] = None,
+    serve: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Assemble + validate a v1 step event (single construction point so the
     schema cannot drift from the writer)."""
@@ -402,6 +432,17 @@ def build_step_event(
         if unknown:
             raise ValueError(
                 f"unknown resilience step-event fields {sorted(unknown)}"
+            )
+    if serve is not None:
+        # serving fields (ISSUE 9): keys appear only when a ServingEngine
+        # emits the record — a training run's JSONL never carries them
+        for key in SERVE_STEP_FIELDS:
+            value = serve.get(key)
+            record[key] = None if value is None else _round(float(value))
+        unknown = set(serve) - set(SERVE_STEP_FIELDS)
+        if unknown:
+            raise ValueError(
+                f"unknown serve step-event fields {sorted(unknown)}"
             )
     validate_step_event(record)
     return record

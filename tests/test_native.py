@@ -67,6 +67,55 @@ def test_gather_pad_ragged(batcher, rng):
         assert mask[i, :L].sum() == L and (mask[i, L:] == 0).all()
 
 
+def test_gather_pad_serve_request_packing(batcher, rng):
+    """Regression for the serving-side packing path (ISSUE 9): the
+    continuous-batching scheduler pads ONE ragged prompt at a time to its
+    prefill bucket via gather_pad — single-row batches, explicit
+    pad_multiple buckets, repeated rows, and the explicit max_len clamp
+    must all behave; previously only the training loader exercised this
+    entry point."""
+    lengths = rng.integers(1, 40, size=20).astype(np.int32)
+    offsets = np.concatenate([[0], np.cumsum(lengths[:-1])]).astype(np.int64)
+    ragged = rng.integers(1, 1000, size=int(lengths.sum())).astype(np.int32)
+    # serve-style: one request per call, padded to its 16-bucket
+    for r in (0, 7, 19):
+        out, mask = batcher.gather_pad(
+            ragged, offsets, lengths, [r], pad_multiple=16
+        )
+        L = int(lengths[r])
+        assert out.shape == (1, -(-L // 16) * 16)
+        np.testing.assert_array_equal(
+            out[0, :L], ragged[offsets[r] : offsets[r] + L]
+        )
+        assert (out[0, L:] == 0).all() and mask[0].sum() == L
+    # explicit max_len TRUNCATES overlong rows (and the mask agrees)
+    r = int(np.argmax(lengths))
+    cap = max(int(lengths[r]) // 2, 1)
+    out, mask = batcher.gather_pad(ragged, offsets, lengths, [r], max_len=cap)
+    assert out.shape == (1, cap)
+    np.testing.assert_array_equal(out[0], ragged[offsets[r] : offsets[r] + cap])
+    assert mask[0].sum() == cap
+    # ragged-length BATCH with repeats: every row independently correct
+    idx = [3, 3, 0, 19, 11]
+    out, mask = batcher.gather_pad(ragged, offsets, lengths, idx, pad_multiple=8)
+    assert out.shape[1] % 8 == 0
+    for i, r in enumerate(idx):
+        L = int(lengths[r])
+        np.testing.assert_array_equal(
+            out[i, :L], ragged[offsets[r] : offsets[r] + L]
+        )
+        assert (out[i, L:] == 0).all() and mask[i].sum() == L
+    # numpy fallback agrees bit-for-bit on the same packing (incl. max_len)
+    fb = NativeBatcher.__new__(NativeBatcher)
+    fb._lib = None
+    fb._pool = None
+    for kwargs in ({"pad_multiple": 8}, {"max_len": 16}):
+        a, am = batcher.gather_pad(ragged, offsets, lengths, idx, **kwargs)
+        b, bm = fb.gather_pad(ragged, offsets, lengths, idx, **kwargs)
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(am, bm)
+
+
 def test_fallback_paths_match(rng):
     """The numpy fallback must agree with the native path exactly."""
     native = NativeBatcher(n_threads=2)
