@@ -204,7 +204,7 @@ REGRESSION_TOLERANCE = 0.05
 #: regression
 _REGRESSION_CONFIG_KEYS = (
     "xla_flags", "steps_per_dispatch", "comm_dtype", "comm_shard_tier",
-    "health", "attribution", "fleet", "tuned", "resilience",
+    "health", "attribution", "fleet", "tuned", "resilience", "trace",
     "serve", "serve_quant", "serve_max_seqs",
 )
 
@@ -703,6 +703,16 @@ def main():
                     "descriptor records tuned/cache_hit columns — a "
                     "distinct configuration for the stale-substitution "
                     "and regression guards")
+    ap.add_argument("--trace", action="store_true",
+                    help="structured-tracing overhead arm (ISSUE 10): run "
+                    "the measured loop with a TraceConfig span ring "
+                    "recording every dispatch/phase, then re-measure with "
+                    "the recorder unplugged, and report the column "
+                    "trace_overhead_frac = (on - off)/off.  The always-on "
+                    "tracing claim is that this stays < 1%; "
+                    "trace_overhead_ok records the verdict.  A distinct "
+                    "configuration for the stale-substitution and "
+                    "regression guards")
     ap.add_argument("--resilience", action="store_true",
                     help="enable pod-scale resilience (ISSUE 7) on the "
                     "measured run: preemption signal handlers, per-save "
@@ -809,6 +819,7 @@ def main():
                 "fleet": True if args.fleet else None,
                 "health": True if args.health else None,
                 "resilience": True if args.resilience else None,
+                "trace": True if args.trace else None,
                 "attribution": (
                     True if args.attribution_peak_tflops else None
                 ),
@@ -933,6 +944,18 @@ def main():
         from stoke_tpu import FleetConfig
 
         run_configs.append(FleetConfig(window_steps=10))
+    if args.trace:
+        # tracing arm (ISSUE 10): the span ring records every dispatch
+        # and facade phase of the measured run; export is skipped so the
+        # arm measures pure record-path overhead, not an exit-time write
+        import tempfile
+
+        from stoke_tpu import TraceConfig
+
+        run_configs.append(TraceConfig(
+            output_dir=tempfile.mkdtemp(prefix="stoke-bench-trace-"),
+            export_on_close=False,
+        ))
     if args.resilience:
         # resilience arm (ISSUE 7): signal handlers + per-save manifests
         # + resilience/* counters ride the measured run.  Nothing
@@ -1038,6 +1061,44 @@ def main():
     t2 = timed(2 * steps)
     dt = max(t2 - t1, 1e-9)
 
+    trace_overhead_frac = None
+    if args.trace:
+        # tracing-off control: SAME facade, SAME compiled programs, SAME
+        # input pools — only the span recorder is unplugged, so the pair
+        # difference is the record path itself.  Sequential arms drown a
+        # sub-1% signal in warm-up drift (the loop keeps speeding up for
+        # several windows), so the arms are measured as ADJACENT
+        # alternating pairs — drift hits both sides of a pair equally —
+        # with the first pair discarded (warm-up, the fleet-view
+        # discipline) and the median of per-pair fractions reported.
+        # The headline dt above stays untouched.
+        from stoke_tpu.telemetry.tracing import (
+            register_recorder,
+            unregister_recorder,
+        )
+
+        def _timed_off(n):
+            unregister_recorder(stoke.tracer)
+            try:
+                return timed(n)
+            finally:
+                register_recorder(stoke.tracer)
+
+        timed(steps)  # settle before the paired windows
+        fracs = []
+        for i in range(7):
+            if i % 2 == 0:
+                d_on = timed(steps)
+                d_off = _timed_off(steps)
+            else:
+                d_off = _timed_off(steps)
+                d_on = timed(steps)
+            fracs.append((d_on - d_off) / d_off)
+        fracs = sorted(fracs[1:])  # discard the warm-up pair
+        mid = len(fracs) // 2
+        median_frac = (fracs[mid - 1] + fracs[mid]) / 2  # even count
+        trace_overhead_frac = max(0.0, median_frac)
+
     imgs_per_sec = batch * steps * per_call / dt
     result = {
         "metric": (
@@ -1118,6 +1179,27 @@ def main():
             None if verdict.get("barrier_wait_s") is None
             else round(verdict["barrier_wait_s"], 4)
         )
+    if args.trace:
+        # tracing columns (ISSUE 10): the overhead verdict of the
+        # always-on span ring against the unplugged control, plus the
+        # measured run's critical path as the ledger descriptor
+        ts = stoke.trace_summary or {}
+        result["trace"] = True
+        result["trace_overhead_frac"] = round(trace_overhead_frac, 6)
+        result["trace_overhead_ok"] = trace_overhead_frac < 0.01
+        result["trace_spans"] = ts.get("spans")
+        result["trace_dropped"] = ts.get("dropped")
+        result["trace_critical_path"] = [
+            {"name": c["name"], "self_s": round(c["self_s"], 4)}
+            for c in ts.get("critical_path", [])[:3]
+        ]
+        if not result["trace_overhead_ok"]:
+            print(
+                f"bench.py TRACE OVERHEAD: tracing-on arm ran "
+                f"{trace_overhead_frac:.2%} slower than tracing-off "
+                f"(claim is < 1%)",
+                file=sys.stderr,
+            )
     if args.resilience:
         # resilience columns (ISSUE 7): the restart/resume accounting of
         # the measured run — quiet here (nothing preempts a bench), but
@@ -1140,7 +1222,7 @@ def main():
         result["cache_miss"] = cc.misses
         result["cache_saved_compile_s"] = round(cc.saved_compile_s, 3)
     if (args.health or args.attribution_peak_tflops or args.fleet
-            or args.resilience):
+            or args.resilience or args.trace):
         stoke.close_telemetry()
     if on_accel:
         regression = check_regression(
@@ -1158,6 +1240,7 @@ def main():
                 ),
                 "fleet": True if args.fleet else None,
                 "resilience": True if args.resilience else None,
+                "trace": True if args.trace else None,
             },
         )
         if regression is not None:
@@ -1238,6 +1321,16 @@ def main():
                         ],
                     }
                     if args.fleet
+                    else {}
+                ),
+                **(
+                    {
+                        "trace": True,
+                        "trace_overhead_frac": result["trace_overhead_frac"],
+                        "trace_overhead_ok": result["trace_overhead_ok"],
+                        "trace_spans": result["trace_spans"],
+                    }
+                    if args.trace
                     else {}
                 ),
                 **(

@@ -1,0 +1,243 @@
+"""Merge per-rank structured-trace files into one pod-wide Perfetto timeline.
+
+The offline twin of the in-process trace view (ISSUE 10): a run with
+``TraceConfig`` leaves one ``trace.rank<N>.json`` per process (chrome-trace
+JSON, ``perf_counter``-clocked).  Those clocks share no epoch across hosts,
+so a naive concat scatters the ranks along the time axis; this tool aligns
+them by **step anchor** — the earliest optimizer step present in every
+rank's events — shifting each rank's timeline so the anchor step's first
+span starts at the same instant as rank 0's.  After the shift, per-rank
+skew *within* a step is exactly what the merged timeline shows: the
+straggler's long dispatch sits visibly past its peers' (the
+``merge_rank_jsonl.py`` skew table, as a picture).
+
+Usable on dead-run bundles: a flight-recorder ``trace.json`` (the span
+ring at time of death) merges the same way — pass the bundle files
+explicitly; files without a rank in their name are automatically
+assigned the lowest indices no named ``trace.rank<N>.json`` claims.
+
+Usage (CPU-safe; never imports jax, never touches an accelerator):
+
+    python scripts/merge_rank_traces.py <dir-or-files...> [--out merged.json]
+        [--anchor-step N] [--json]
+
+``<dir>`` is scanned for ``trace.rank*.json``.  Two files parsing to the
+same rank are refused (merging two hosts' rings into one rank would draw a
+chimera timeline).  Exit 0 on a clean merge, 2 when nothing could be
+aligned.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_RANK_RE = re.compile(r"trace\.rank(\d+)\.json$")
+
+
+def discover_traces(paths: List[str]) -> List[Tuple[int, str]]:
+    """``[(rank, path), ...]`` from a mix of directories and files.
+
+    Two files PARSING to the same rank raise — silently merging one
+    host's ring into another's would place both hosts' spans on one
+    process row and the skew picture would lie.  Unnamed files (a
+    bundle's ``trace.json``) carry no rank claim and take the next free
+    index."""
+    named: List[Tuple[int, str]] = []
+    unnamed: List[str] = []
+    used: set = set()
+    for p in paths:
+        files = (
+            sorted(glob.glob(os.path.join(p, "trace.rank*.json")))
+            if os.path.isdir(p)
+            else [p]
+        )
+        for f in files:
+            m = _RANK_RE.search(os.path.basename(f))
+            if m is None:
+                unnamed.append(f)
+                continue
+            rank = int(m.group(1))
+            if rank in used:
+                raise ValueError(
+                    f"{f}: rank {rank} already provided by another "
+                    f"trace — merging two hosts' rings into one rank "
+                    f"would draw a chimera timeline (pass one run's "
+                    f"files at a time)"
+                )
+            used.add(rank)
+            named.append((rank, f))
+    # fallback indices only AFTER all named claims are collected: an
+    # unnamed bundle trace listed before trace.rank0.json must not
+    # squat on rank 0 and refuse the named file's legitimate claim
+    out = list(named)
+    fallback = 0
+    for f in unnamed:
+        while fallback in used:
+            fallback += 1
+        used.add(fallback)
+        out.append((fallback, f))
+    out.sort()
+    return out
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    """The ``traceEvents`` list of one trace file (bare-list files — the
+    chrome-trace array format — are accepted too)."""
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents") if isinstance(doc, dict) else doc
+    if not isinstance(events, list):
+        # ValueError, not KeyError: main()'s salvage path catches this
+        # and keeps merging the readable ranks
+        raise ValueError(f"{path}: no traceEvents list")
+    return events
+
+
+def _steps_present(events: List[Dict[str, Any]]) -> set:
+    return {
+        e["args"]["step"]
+        for e in events
+        if e.get("ph") == "X" and isinstance(e.get("args"), dict)
+        and "step" in e["args"]
+    }
+
+
+def _anchor_ts(events: List[Dict[str, Any]], step: int) -> Optional[float]:
+    """Earliest ``ts`` of a duration event tagged with ``step`` — the
+    rank's anchor instant for the shift."""
+    ts = [
+        e["ts"]
+        for e in events
+        if e.get("ph") == "X" and isinstance(e.get("args"), dict)
+        and e["args"].get("step") == step
+    ]
+    return min(ts) if ts else None
+
+
+def merge_traces(
+    traces: Dict[int, List[Dict[str, Any]]],
+    anchor_step: Optional[int] = None,
+) -> Tuple[List[Dict[str, Any]], Dict[str, Any]]:
+    """Shift every rank's events so the anchor step's first span aligns
+    with rank 0's (the lowest rank's, when 0 is absent); returns
+    ``(merged_events, report)``.  Raises ValueError when no common step
+    exists (or the requested anchor is missing from some rank)."""
+    ranks = sorted(traces)
+    if anchor_step is None:
+        common = set.intersection(
+            *(_steps_present(evs) for evs in traces.values())
+        )
+        # step 0 tags spans recorded before the first boundary (warm-up);
+        # prefer a real optimizer-step anchor when one is common
+        preferred = common - {0}
+        if preferred:
+            anchor_step = min(preferred)
+        elif common:
+            anchor_step = min(common)
+        else:
+            raise ValueError(
+                "no optimizer step is present in every rank's trace; "
+                "nothing to align on (pass --anchor-step to force one)"
+            )
+    anchors: Dict[int, float] = {}
+    for rank in ranks:
+        ts = _anchor_ts(traces[rank], anchor_step)
+        if ts is None:
+            raise ValueError(
+                f"rank {rank} has no span tagged step {anchor_step}; "
+                f"cannot align (its steps: "
+                f"{sorted(_steps_present(traces[rank]))[:10]})"
+            )
+        anchors[rank] = ts
+    base = anchors[ranks[0]]
+    merged: List[Dict[str, Any]] = []
+    shifts: Dict[int, float] = {}
+    for rank in ranks:
+        shift = base - anchors[rank]
+        shifts[rank] = shift
+        for e in traces[rank]:
+            e = dict(e)
+            e["pid"] = rank  # one Perfetto process row per rank
+            if "ts" in e and e.get("ph") != "M":
+                e["ts"] = e["ts"] + shift
+            merged.append(e)
+    merged.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
+    report = {
+        "ranks": ranks,
+        "anchor_step": anchor_step,
+        "shift_us": {str(r): shifts[r] for r in ranks},
+        "events": sum(
+            1 for e in merged if e.get("ph") == "X"
+        ),
+    }
+    return merged, report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="align per-rank trace.rank<N>.json files by step "
+        "anchor into one Perfetto-loadable pod timeline"
+    )
+    ap.add_argument("paths", nargs="+",
+                    help="trace output dir(s) or explicit trace files")
+    ap.add_argument("--out", default="trace.merged.json",
+                    help="merged chrome-trace output path")
+    ap.add_argument("--anchor-step", type=int, default=None,
+                    help="force the alignment step (default: the earliest "
+                    "step present in every rank)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the merge report as one JSON document")
+    args = ap.parse_args(argv)
+
+    try:
+        found = discover_traces(args.paths)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if not found:
+        print("no trace*.json files found", file=sys.stderr)
+        return 2
+    traces: Dict[int, List[Dict[str, Any]]] = {}
+    for rank, path in found:
+        try:
+            events = load_events(path)
+        except (OSError, ValueError) as e:
+            # dead-run salvage norm: report and keep merging what IS
+            # readable (same policy as merge_rank_jsonl)
+            print(f"skipping {path}: {e}", file=sys.stderr)
+            continue
+        if events:
+            traces[rank] = events
+    if not traces:
+        print("no readable events in any trace", file=sys.stderr)
+        return 2
+    try:
+        merged, report = merge_traces(traces, args.anchor_step)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    with open(args.out, "w") as f:
+        json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, f)
+        f.write("\n")
+    report["out"] = args.out
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(
+            f"merged {report['events']} spans from ranks "
+            f"{report['ranks']} (anchor step {report['anchor_step']}) "
+            f"-> {args.out}"
+        )
+        for r in report["ranks"]:
+            print(f"  rank {r}: shift {report['shift_us'][str(r)]:+.1f} us")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

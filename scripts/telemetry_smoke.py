@@ -12,8 +12,11 @@ written, counters restored); since ISSUE 8, one sharded-transport step
 JSONL); since ISSUE 9, one serve cycle (two concurrent requests through
 the continuous-batching paged-KV engine with int8 weights: TTFT/TPOT
 fields in the JSONL, >= 3.5x compression asserted, blocks drained back
-to the pool).  Prints the step record and a one-line verdict; exit 0
-only when everything round-trips.
+to the pool); since ISSUE 10, one traced train window + one traced serve
+request (the exported trace.rank0.json files must parse as chrome-trace
+JSON and carry engine step spans AND a full per-request
+admission->prefill->decode timeline).  Prints the step record and a
+one-line verdict; exit 0 only when everything round-trips.
 """
 
 from __future__ import annotations
@@ -37,6 +40,7 @@ def main() -> int:
         Stoke,
         StokeOptimizer,
         TelemetryConfig,
+        TraceConfig,
     )
     from stoke_tpu.telemetry import read_step_events
     from stoke_tpu.utils.tb_writer import read_scalar_events
@@ -59,6 +63,10 @@ def main() -> int:
     # fleet view (ISSUE 5): one exchange window end-to-end — a fleet of
     # one host on CPU, proving the packed-vector/aggregation/JSONL path
     fcfg = FleetConfig(window_steps=1)
+    # structured tracing (ISSUE 10): the span ring records the train
+    # window below; the exported trace.rank0.json is parsed at the end
+    tr_dir = os.path.join(out_dir, "trace")
+    trcfg = TraceConfig(output_dir=tr_dir, ring_size=512)
     stoke = Stoke(
         model=lambda p, x: x @ p["w"],
         optimizer=StokeOptimizer(
@@ -67,7 +75,7 @@ def main() -> int:
         loss=lambda o, y: ((o - y) ** 2).mean(),
         params={"w": np.ones((8, 4), np.float32)},
         batch_size_per_device=16,
-        configs=[cfg, hcfg, acfg, fcfg],
+        configs=[cfg, hcfg, acfg, fcfg, trcfg],
         verbose=False,
     )
     x = np.ones((16, 8), np.float32)
@@ -254,6 +262,9 @@ def main() -> int:
                 max_new_tokens=4, prefill_pad_multiple=16,
                 quant="int8", quant_min_size=256,
             ),
+            # traced serve request (ISSUE 10): the per-request
+            # admission -> prefill -> decode timeline is parsed below
+            TraceConfig(output_dir=os.path.join(sv_dir, "trace")),
         ],
         verbose=False,
     )
@@ -280,6 +291,36 @@ def main() -> int:
         and sv_eng.allocator.used_blocks == 0
         and "stoke_serve_ttft_s" in sv_prom
         and "stoke_serve_kv_block_occupancy" in sv_prom
+    )
+
+    # structured tracing (ISSUE 10): both exported traces must parse as
+    # chrome-trace JSON; the train trace must carry engine step spans,
+    # the serve trace at least one full request timeline — admission,
+    # prefill, and decode spans sharing one request_id
+    def _trace_events(path):
+        with open(path) as f:
+            doc = json.load(f)
+        return [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+
+    train_trace = _trace_events(os.path.join(tr_dir, "trace.rank0.json"))
+    serve_trace = _trace_events(
+        os.path.join(sv_dir, "trace", "trace.rank0.json")
+    )
+    step_span_names = {e["name"] for e in train_trace}
+    spans_by_rid = {}
+    for e in serve_trace:
+        rid = (e.get("args") or {}).get("request_id")
+        if rid is not None:
+            spans_by_rid.setdefault(rid, set()).add(e["name"])
+    tracing_ok = (
+        bool(step_span_names & {"stoke/dispatch", "stoke/accum", "stoke/step"})
+        and "stoke/place" in step_span_names
+        and sum(
+            1
+            for names in spans_by_rid.values()
+            if {"serve/admission", "serve/prefill", "serve/decode"} <= names
+        ) >= 2
+        and (stoke.trace_summary or {}).get("spans", 0) > 0
     )
 
     records = read_step_events(os.path.join(out_dir, "steps.jsonl"))
@@ -322,6 +363,8 @@ def main() -> int:
         "goodput.json", "cost_cards.json",
         # ISSUE 5: which host was slow at time of death
         "fleet.json",
+        # ISSUE 10: what the host was doing at time of death
+        "trace.json",
     } <= bundle_files
     ring_kinds = set()
     if bundle_ok:
@@ -354,6 +397,7 @@ def main() -> int:
         and resilience_ok
         and zero_ok
         and serving_ok
+        and tracing_ok
         # default-OFF discipline (ISSUE 9): training records never carry
         # serve fields
         and not any(k.startswith("serve/") for k in rec)
@@ -384,6 +428,10 @@ def main() -> int:
         "serve_ttft_p50_s": sv_rec.get("serve/ttft_p50_s"),
         "serve_tpot_p50_s": sv_rec.get("serve/tpot_p50_s"),
         "serve_quant_compression": sv_rec.get("serve/quant_compression"),
+        "tracing": "ok" if tracing_ok else "FAILED",
+        "trace_train_spans": len(train_trace),
+        "trace_serve_spans": len(serve_trace),
+        "trace_requests": sorted(spans_by_rid),
     }))
     return 0 if ok else 1
 

@@ -38,6 +38,7 @@ from stoke_tpu.configs import (
     ServeConfig,
     TelemetryConfig,
     TensorboardConfig,
+    TraceConfig,
     ShardingOptions,
     StokeOptimizer,
 )
@@ -108,6 +109,7 @@ __all__ = [
     "ServeConfig",
     "TelemetryConfig",
     "TensorboardConfig",
+    "TraceConfig",
     # adapters
     "ModelAdapter",
     "FlaxModelAdapter",

@@ -689,6 +689,50 @@ class TelemetryConfig:
     xprof_annotations: bool = True
 
 
+@dataclass
+class TraceConfig:
+    """Always-on structured host tracing (ISSUE 10 tentpole): a bounded
+    span ring, Perfetto export, per-request serve timelines, and a
+    critical-path summary.
+
+    No reference equivalent (the reference has no tracing story at all);
+    the prior art here is ``xprof_span`` — a ``jax.profiler
+    .TraceAnnotation`` visible only inside an active xprof capture.  With
+    this config, every annotated section (engine ``stoke/accum`` /
+    ``stoke/dispatch`` / ``stoke/step``, facade ``stoke/place`` /
+    ``stoke/io`` and the ``facade/*`` phase timers, loader waits,
+    checkpoint save/wait, and the serving path's per-request
+    admission → prefill → decode → evict spans) ALSO lands in a host-side
+    ring of ``(name, track, t_start, dur, step, request_id, parent_id)``
+    spans recorded from ``perf_counter`` pairs — no profiler attachment
+    required, O(1) per span, no IO on the hot path.
+
+    Default OFF — without this config no recorder is registered, the
+    composed span helper degrades to the bare annotation, and the step
+    programs/dispatch counts are bit-identical to a config-less run
+    (tracing is purely host-side, so they are bit-identical WITH it too;
+    tests pin both).
+
+    Outputs: ``trace.rank<N>.json`` (chrome-trace/Perfetto JSON, one per
+    process — ``scripts/merge_rank_traces.py`` aligns ranks by step
+    anchor), ``Stoke.trace_summary`` (per-name self-time critical path),
+    ``trace/*`` registry counters in the telemetry exposition, and a
+    ``trace.json`` span ring in every flight-recorder post-mortem bundle.
+
+    Attributes:
+        output_dir: directory ``trace.rank<N>.json`` is exported into
+            (every rank writes its own file; status-validated writable).
+        ring_size: span-ring capacity (entries, FIFO; a full ring evicts
+            oldest-first and counts ``trace/dropped_total``).
+        export_on_close: write the trace file in ``close_telemetry()``
+            (off for runs that only want the live summary/bundle ring).
+    """
+
+    output_dir: str = "trace"
+    ring_size: int = 4096
+    export_on_close: bool = True
+
+
 #: actions a health detector may take when it fires (validated by status.py)
 HEALTH_ACTIONS: Tuple[str, ...] = ("record", "warn", "dump", "halt")
 
@@ -1288,6 +1332,7 @@ ALL_CONFIG_CLASSES: Tuple[type, ...] = (
     ServeConfig,
     TelemetryConfig,
     TensorboardConfig,
+    TraceConfig,
 )
 
 

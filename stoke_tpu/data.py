@@ -418,10 +418,10 @@ class StokeDataLoader:
         yield from self._iter_batches(wait, starve)
 
     def _iter_batches(self, wait_counter=None, starve_counter=None):
-        from stoke_tpu.telemetry.collectors import xprof_span
+        from stoke_tpu.telemetry.tracing import trace_span
 
         def fetch(it, warm: bool):
-            with xprof_span("stoke/io"):
+            with trace_span("stoke/io", track="data"):
                 if wait_counter is None:
                     return next(it)
                 return self._next_timed(

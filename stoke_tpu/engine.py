@@ -50,7 +50,7 @@ from stoke_tpu.configs import (
 )
 from stoke_tpu.parallel.zero import make_transport
 from stoke_tpu.parallel.sharding import ShardingRules, place_global_tree
-from stoke_tpu.telemetry.collectors import xprof_span
+from stoke_tpu.telemetry.tracing import trace_span
 from stoke_tpu.telemetry.health import compute_sentinels
 from stoke_tpu.utils.trees import tree_cast, tree_finite, tree_zeros_like
 
@@ -840,7 +840,7 @@ class StepEngine:
              loss_args_flat),
         )
         self.dispatch_count += 1
-        with xprof_span("stoke/accum"):
+        with trace_span("stoke/accum", track="step"):
             return call(
                 variables, grad_buf, scaler_state, rng, margs, mkwargs,
                 loss_args_flat,
@@ -1087,7 +1087,7 @@ class StepEngine:
              margs_stacked, mkwargs_stacked, loss_args_flat_stacked),
         )
         self.dispatch_count += 1
-        with xprof_span("stoke/dispatch"):
+        with trace_span("stoke/dispatch", track="step"):
             return call(
                 variables, opt_state, grad_buf, scaler_state, comm_state,
                 rng, margs_stacked, mkwargs_stacked, loss_args_flat_stacked,
@@ -1233,7 +1233,7 @@ class StepEngine:
              margs_stacked, mkwargs_stacked, loss_args_flat_stacked),
         )
         self.dispatch_count += 1
-        with xprof_span("stoke/dispatch"):
+        with trace_span("stoke/dispatch", track="step"):
             return call(
                 variables, opt_state, grad_buf, scaler_state, comm_state,
                 rng, margs_stacked, mkwargs_stacked, loss_args_flat_stacked,
@@ -1318,7 +1318,7 @@ class StepEngine:
              loss_val),
         )
         self.dispatch_count += 1
-        with xprof_span("stoke/step"):
+        with trace_span("stoke/step", track="step"):
             return call(
                 variables, opt_state, grad_buf, scaler_state, comm_state,
                 loss_val,
@@ -1490,7 +1490,7 @@ class StepEngine:
                 (variables, opt_state, grad_buf, scaler_state, comm_state,
                  rng, margs, mkwargs, loss_args_flat),
             )
-            with xprof_span("stoke/dispatch"):
+            with trace_span("stoke/dispatch", track="step"):
                 return call(
                     variables, opt_state, grad_buf, scaler_state, comm_state,
                     rng, margs, mkwargs, loss_args_flat,
@@ -1510,7 +1510,7 @@ class StepEngine:
             (variables, grad_buf, scaler_state, rng, margs, mkwargs,
              loss_args_flat),
         )
-        with xprof_span("stoke/dispatch"):
+        with trace_span("stoke/dispatch", track="step"):
             (report, updated, new_vars, new_buf, new_scaler, new_rng,
              finite) = call(
                 variables, grad_buf, scaler_state, rng, margs, mkwargs,

@@ -54,7 +54,7 @@ from stoke_tpu.parallel.mesh import build_mesh, initialize_distributed
 from stoke_tpu.parallel.sharding import make_sharding_rules, place_global_tree
 from stoke_tpu.status import StokeStatus
 from stoke_tpu.telemetry import Telemetry
-from stoke_tpu.telemetry.collectors import xprof_span
+from stoke_tpu.telemetry.tracing import trace_span
 from stoke_tpu.telemetry.health import (
     SENTINEL_INDEX,
     HealthHaltError,
@@ -435,6 +435,28 @@ class Stoke:
         self._engine._compile_tracker = self._telemetry.compile_tracker
         self._last_grad_norm: Optional[float] = None
 
+        # ----- structured tracing (ISSUE 10: bounded host-span ring +
+        #       Perfetto export + per-request serve timelines; default OFF
+        #       — without a TraceConfig no recorder is registered and the
+        #       composed span helper degrades to the bare xprof
+        #       annotation.  Purely host-side either way: step-program
+        #       HLO and dispatch counts are bit-identical with the config
+        #       absent OR present) -----
+        self._tracer = None
+        tcfg = st.trace_config
+        if tcfg is not None:
+            from stoke_tpu.telemetry.tracing import (
+                TraceRecorder,
+                register_recorder,
+            )
+
+            self._tracer = TraceRecorder(
+                tcfg,
+                rank=jax.process_index(),
+                registry=self._telemetry.registry,
+            )
+            register_recorder(self._tracer)
+
         # ----- persistent AOT compile cache (ISSUE 6: warm starts load
         #       backend compiles from the persistent XLA disk cache and
         #       the HLO-keyed program ledger books the reclaimed seconds;
@@ -515,6 +537,13 @@ class Stoke:
                 fleet_fn=lambda: (
                     self._fleet.snapshot()
                     if self._fleet is not None
+                    else None
+                ),
+                # ISSUE 10: the span ring at time of death — every bundle
+                # gains a Perfetto-loadable trace.json when tracing is on
+                trace_fn=(
+                    self._tracer.to_trace_events
+                    if self._tracer is not None
                     else None
                 ),
             )
@@ -603,7 +632,11 @@ class Stoke:
         #       async, use profile_trace() for device timelines).  Backed by
         #       the telemetry registry; enabling telemetry implies it -----
         self._wall_clock_enabled = (
-            st.profiler_config.wall_clock_breakdown or self._telemetry.enabled
+            st.profiler_config.wall_clock_breakdown
+            or self._telemetry.enabled
+            # tracing needs the facade phase sections live: each timed
+            # phase is also a trace span (ISSUE 10 consolidation)
+            or self._tracer is not None
         )
 
         # ----- post-init status (reference stoke.py:245) -----
@@ -777,7 +810,7 @@ class Stoke:
                 return jax.make_array_from_process_local_data(sh, x)
             return jax.device_put(x, sh)
 
-        with xprof_span("stoke/place"):
+        with trace_span("stoke/place", track="facade"):
             return jax.tree_util.tree_map(_leaf, tree)
 
     # ------------------------------------------------------------------ #
@@ -1307,6 +1340,36 @@ class Stoke:
         return self._telemetry.fleet_summary()
 
     @property
+    def tracer(self):
+        """The run's structured-trace recorder (None without a
+        ``TraceConfig``) — the bounded span ring, Perfetto exporter, and
+        critical-path summary."""
+        return self._tracer
+
+    @property
+    def trace_summary(self) -> Optional[Dict[str, Any]]:
+        """Critical-path/self-time summary of the trace ring's window
+        (per-span-name counts, total and self seconds, and the ranked
+        ``critical_path`` — host spans are serial, so the top self-time
+        entries are where the host wall clock went).  None without a
+        ``TraceConfig``."""
+        if self._tracer is None:
+            return None
+        return self._tracer.summary()
+
+    def export_trace(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the span ring as Chrome/Perfetto trace-event JSON
+        (``trace.rank<N>.json`` under ``TraceConfig.output_dir`` unless
+        ``path`` overrides); returns the path, or None without a
+        ``TraceConfig``.  ``close_telemetry()`` calls this automatically
+        when ``TraceConfig.export_on_close`` is set; calling it mid-run
+        snapshots the current ring (load in ui.perfetto.dev, or merge
+        ranks with ``scripts/merge_rank_traces.py``)."""
+        if self._tracer is None:
+            return None
+        return self._tracer.export(path)
+
+    @property
     def dispatch_count(self) -> int:
         """Compiled-program invocations issued by this run's engine (the
         health acceptance counter: sentinels must not add dispatches)."""
@@ -1326,6 +1389,10 @@ class Stoke:
         """Assemble + emit one structured step event at the telemetry
         cadence (JSONL / Prometheus / TB sinks).  Device->host transfers
         (EMA loss, loss scale) happen only here, never per micro-batch."""
+        if self._tracer is not None:
+            # tag subsequent spans with the last completed optimizer step
+            # (the step anchor the cross-rank trace merge aligns on)
+            self._tracer.set_step(self._optimizer_steps)
         t = self._telemetry
         if not t.enabled or self._optimizer_steps == 0:
             return
@@ -1409,6 +1476,18 @@ class Stoke:
                 self._health.observe(self._optimizer_steps, None)
             except HealthHaltError:
                 pass
+        if self._tracer is not None:
+            # stop receiving other runs' spans, then export the final ring
+            # (idempotent: a second close re-exports the same ring)
+            from stoke_tpu.telemetry.tracing import unregister_recorder
+
+            unregister_recorder(self._tracer)
+            tcfg = self._status_obj.trace_config
+            if tcfg is not None and tcfg.export_on_close:
+                try:
+                    self._tracer.export()
+                except OSError as e:
+                    self.warn(f"trace export failed: {e}")
         self._telemetry.close()
         if self._resilience is not None:
             # uninstall the preemption signal handlers BEFORE the health
@@ -2542,7 +2621,7 @@ class Stoke:
             k: v for k, v in self._variables.items() if k != "losses"
         }
         mon = self._resilience
-        with xprof_span("stoke/io"):
+        with trace_span("stoke/io", track="io"):
             tag_dir = io_ops.save_checkpoint(
                 path=path,
                 name=name,
@@ -2602,7 +2681,7 @@ class Stoke:
         }
 
         def _load(like):
-            with xprof_span("stoke/io"):
+            with trace_span("stoke/io", track="io"):
                 return io_ops.load_checkpoint(
                     path=path,
                     tag=tag,

@@ -39,6 +39,8 @@ _INFLIGHT_TAGS: set = set()  # tag dirs being written by async saves (prune must
 import jax
 import numpy as np
 
+from stoke_tpu.telemetry.tracing import trace_span
+
 from stoke_tpu.configs import CheckpointConfig, CheckpointFormat
 from stoke_tpu.utils.printing import make_folder, unrolled_print
 
@@ -275,7 +277,10 @@ def save_checkpoint(
             # orbax AsyncCheckpointer: device→host copy on this thread,
             # sharded tensorstore writes + cross-host commit in background
             try:
-                handles = _save_sharded_async(tag_dir, state)
+                # traced: the async save's main-thread (step-path) cost
+                with trace_span("stoke/ckpt_save", track="io",
+                                attrs={"tag": tag, "async": True}):
+                    handles = _save_sharded_async(tag_dir, state)
             except BaseException:
                 _INFLIGHT_TAGS.discard(tag_dir)
                 raise
@@ -295,7 +300,12 @@ def save_checkpoint(
         else:
             # consolidated: gather (collective, main thread) → proc-0 write
             try:
-                host_state = {k: _gather_to_host(v) for k, v in state.items()}
+                # traced: the async save's main-thread (step-path) cost
+                with trace_span("stoke/ckpt_save", track="io",
+                                attrs={"tag": tag, "async": True}):
+                    host_state = {
+                        k: _gather_to_host(v) for k, v in state.items()
+                    }
             except BaseException:
                 _INFLIGHT_TAGS.discard(tag_dir)  # claim released on gather failure
                 raise
@@ -346,12 +356,17 @@ def save_checkpoint(
             _INFLIGHT_TAGS.discard(tag_dir)
             raise
         return tag_dir
-    if config.format is CheckpointFormat.consolidated:
-        _save_consolidated(tag_dir, state, writer)
-    else:
-        _save_sharded(tag_dir, state)
-    _write_meta()
-    _barrier()
+    # the save span (ISSUE 10): the synchronous write path end-to-end —
+    # gather, payload, metadata, barrier.  The async path above is traced
+    # per-phase instead (its main-thread cost is the gather; the
+    # background write is off the step path by design).
+    with trace_span("stoke/ckpt_save", track="io", attrs={"tag": tag}):
+        if config.format is CheckpointFormat.consolidated:
+            _save_consolidated(tag_dir, state, writer)
+        else:
+            _save_sharded(tag_dir, state)
+        _write_meta()
+        _barrier()
     return tag_dir
 
 
@@ -372,9 +387,10 @@ def wait_for_saves() -> None:
     checkpoints are trustworthy needs the full casualty list, not the first
     failure with "+2 more"); the first underlying exception chains as the
     cause and the rest are summarized inline."""
-    while _ASYNC_SAVES:
-        _ASYNC_SAVES.pop().join()
-    _barrier()
+    with trace_span("stoke/ckpt_wait", track="io"):
+        while _ASYNC_SAVES:
+            _ASYNC_SAVES.pop().join()
+        _barrier()
     if _ASYNC_ERRORS:
         failures = list(_ASYNC_ERRORS)
         _ASYNC_ERRORS.clear()

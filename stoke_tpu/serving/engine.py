@@ -55,6 +55,12 @@ from stoke_tpu.serving.quant import (
 from stoke_tpu.serving.scheduler import Request, Scheduler
 from stoke_tpu.serving.telemetry import ServeMetrics
 from stoke_tpu.telemetry.registry import MetricsRegistry
+from stoke_tpu.telemetry.tracing import (
+    trace_add,
+    trace_point,
+    trace_span,
+    tracing_active,
+)
 
 _KV_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
 
@@ -299,21 +305,34 @@ class ServingEngine:
         m = self.metrics
 
         for slot, req, padded, plen in sched.admit():
+            if tracing_active():
+                # the request timeline's first span: arrival → admission
+                # (the queue wait) on the request's own track row
+                # count_self=False: the queue wait overlaps other
+                # requests' prefill/decode spans, which own that wall
+                trace_add(
+                    "serve/admission", req.arrival_ts, req.admit_ts,
+                    track="serve", request_id=req.rid,
+                    attrs={"prompt_len": plen}, count_self=False,
+                )
             t0 = time.perf_counter()
-            tok, k_pages, v_pages = self._dispatch(
-                "serve_prefill",
-                self._prefill_jit,
-                (
-                    self.qparams,
-                    self.cache.k_pages,
-                    self.cache.v_pages,
-                    jnp.asarray(padded),
-                    jnp.asarray(sched.block_tables[slot : slot + 1]),
-                    jnp.array([plen], jnp.int32),
-                ),
-            )
-            self.cache.k_pages, self.cache.v_pages = k_pages, v_pages
-            tok_host = int(np.asarray(tok)[0])  # sync: the TTFT point
+            with trace_span("serve/prefill", track="serve",
+                            request_id=req.rid,
+                            attrs={"padded_len": int(padded.shape[1])}):
+                tok, k_pages, v_pages = self._dispatch(
+                    "serve_prefill",
+                    self._prefill_jit,
+                    (
+                        self.qparams,
+                        self.cache.k_pages,
+                        self.cache.v_pages,
+                        jnp.asarray(padded),
+                        jnp.asarray(sched.block_tables[slot : slot + 1]),
+                        jnp.array([plen], jnp.int32),
+                    ),
+                )
+                self.cache.k_pages, self.cache.v_pages = k_pages, v_pages
+                tok_host = int(np.asarray(tok)[0])  # sync: the TTFT point
             now = time.perf_counter()
             m.prefills.inc()
             m.prefill_s.inc(now - t0)
@@ -324,24 +343,47 @@ class ServingEngine:
                 self._finish(req)
 
         if sched.active > 0:
-            t0 = time.perf_counter()
-            tokens, positions, tables, context = sched.decode_batch()
-            next_tok, k_pages, v_pages = self._dispatch(
-                "serve_decode",
-                self._decode_jit,
-                (
-                    self.qparams,
-                    self.cache.k_pages,
-                    self.cache.v_pages,
-                    jnp.asarray(tokens),
-                    jnp.asarray(positions),
-                    jnp.asarray(tables),
-                    jnp.asarray(context),
-                ),
+            # the live slots' request ids BEFORE the commit evicts any —
+            # each gets a per-request decode-slice span below
+            live_rids = (
+                [
+                    s.request.rid
+                    for s in sched.slots
+                    if s.request is not None
+                ]
+                if tracing_active()
+                else None
             )
-            self.cache.k_pages, self.cache.v_pages = k_pages, v_pages
-            next_host = np.asarray(next_tok)  # sync: tokens stream out
+            t0 = time.perf_counter()
+            with trace_span("serve/decode_step", track="serve",
+                            attrs={"active": sched.active}):
+                tokens, positions, tables, context = sched.decode_batch()
+                next_tok, k_pages, v_pages = self._dispatch(
+                    "serve_decode",
+                    self._decode_jit,
+                    (
+                        self.qparams,
+                        self.cache.k_pages,
+                        self.cache.v_pages,
+                        jnp.asarray(tokens),
+                        jnp.asarray(positions),
+                        jnp.asarray(tables),
+                        jnp.asarray(context),
+                    ),
+                )
+                self.cache.k_pages, self.cache.v_pages = k_pages, v_pages
+                next_host = np.asarray(next_tok)  # sync: tokens stream out
             now = time.perf_counter()
+            if live_rids:
+                # per-request decode slices: every live request's timeline
+                # row shows the batch decode interval it rode (the TPOT
+                # structure the histograms only summarize).
+                # count_self=False: all slices share ONE interval the
+                # serve/decode_step span above already owns — charging
+                # each would multiply-count the window by batch depth
+                for rid in live_rids:
+                    trace_add("serve/decode", t0, now, track="serve",
+                              request_id=rid, count_self=False)
             m.decode_steps.inc()
             m.decode_s.inc(now - t0)
             was_finished = set(sched.finished)
@@ -390,6 +432,12 @@ class ServingEngine:
     # ------------------------------------------------------------------ #
 
     def _finish(self, req: Request) -> None:
+        # eviction marker closes the request's trace timeline (its blocks
+        # are already back in the pool — scheduler._finish freed them)
+        trace_point(
+            "serve/evict", track="serve", request_id=req.rid,
+            attrs={"tokens": len(req.tokens)},
+        )
         m = self.metrics
         m.completed.inc()
         tpot = req.tpot_s

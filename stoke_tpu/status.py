@@ -61,6 +61,7 @@ from stoke_tpu.configs import (
     ShardingOptions,
     TelemetryConfig,
     TensorboardConfig,
+    TraceConfig,
     asdict_config,
 )
 
@@ -736,6 +737,31 @@ class StokeStatus:
                 )
             return False
 
+        def _trace_invalid(s):
+            """Structured-tracing legality (ISSUE 10): the recorder's ring
+            must be able to hold at least one span, and — since EVERY rank
+            exports its own ``trace.rank<N>.json`` — an unwritable output
+            dir is fatal on every process, not only rank 0.  The config is
+            purely host-side; its presence never touches the compiled step
+            programs (default-OFF contract, tests/test_tracing.py asserts
+            HLO bit-identity)."""
+            cfg = self._configs.get("TraceConfig")
+            if cfg is None:
+                return False
+            if cfg.ring_size < 1:
+                return (
+                    f"TraceConfig.ring_size must be >= 1, got "
+                    f"{cfg.ring_size}"
+                )
+            if cfg.export_on_close:
+                err = _probe_writable(cfg.output_dir)
+                if err is not None:
+                    return (
+                        f"TraceConfig.output_dir {cfg.output_dir!r} is not "
+                        f"writable: {err}"
+                    )
+            return False
+
         def _serve_invalid(s):
             """Serving-stack legality (ISSUE 9): a ServeConfig that could
             never admit a request, that names an unknown kernel/dtype/
@@ -945,6 +971,10 @@ class StokeStatus:
             (
                 _serve_invalid,
                 "ServeConfig is invalid",
+            ),
+            (
+                _trace_invalid,
+                "TraceConfig is invalid",
             ),
             (
                 _offload_cpu_no_fallback,
@@ -1205,6 +1235,13 @@ class StokeStatus:
         is opt-in; a None config keeps the facade's registry alive but
         attaches no sinks/collectors)."""
         return self._configs.get("TelemetryConfig")
+
+    @property
+    def trace_config(self) -> Optional[TraceConfig]:
+        """None unless explicitly supplied (structured tracing is opt-in;
+        without it no span recorder is registered and the composed span
+        helper degrades to the bare xprof annotation)."""
+        return self._configs.get("TraceConfig")
 
     # ------------------------------------------------------------------ #
     # Serialization / display (reference status.py:629-654)

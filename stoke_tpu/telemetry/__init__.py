@@ -80,6 +80,18 @@ from stoke_tpu.telemetry.fleet import (
     unregister_sync_registry,
 )
 from stoke_tpu.telemetry.recorder import FlightRecorder
+from stoke_tpu.telemetry.tracing import (
+    TRACE_EVENT_KEYS,
+    ComposedContext,
+    Span,
+    TraceRecorder,
+    register_recorder,
+    trace_add,
+    trace_point,
+    trace_span,
+    tracing_active,
+    unregister_recorder,
+)
 from stoke_tpu.telemetry.registry import (
     Counter,
     Gauge,
@@ -148,6 +160,17 @@ __all__ = [
     "unregister_sync_registry",
     "observe_sync_wait",
     "timed_sync",
+    # structured tracing (ISSUE 10)
+    "TRACE_EVENT_KEYS",
+    "ComposedContext",
+    "Span",
+    "TraceRecorder",
+    "register_recorder",
+    "unregister_recorder",
+    "trace_span",
+    "trace_point",
+    "trace_add",
+    "tracing_active",
 ]
 
 
@@ -254,12 +277,15 @@ class Telemetry:
 
     def phase(self, name: str, annotate: bool = True):
         """Timer for a facade/engine phase: seconds accumulate into
-        ``facade/<name>_s`` (the wall-clock breakdown) and the span is
-        labeled in xprof timelines."""
+        ``facade/<name>_s`` (the wall-clock breakdown), the span is
+        labeled in xprof timelines, AND — with a trace recorder
+        registered (ISSUE 10) — the same section lands in the host span
+        ring, so every timed section is also a trace span (one composed
+        helper instead of the hand-rolled span+timer pairing)."""
         timer = self.registry.timer(f"facade/{name}_s")
         if not annotate:
             return timer
-        return _ComposedContext(xprof_span(f"stoke/{name}"), timer)
+        return trace_span(f"stoke/{name}", track="facade", timer=timer)
 
     def log_scalar(self, tag: str, value: float) -> None:
         """User scalar -> gauge ``user/<tag>`` (mirrored to sinks at the
@@ -551,24 +577,3 @@ class Telemetry:
                 sink.close()
             except Exception:
                 pass
-
-
-class _ComposedContext:
-    """Enter/exit a sequence of context managers as one (span + timer)."""
-
-    __slots__ = ("_cms",)
-
-    def __init__(self, *cms):
-        self._cms = cms
-
-    def __enter__(self):
-        for cm in self._cms:
-            cm.__enter__()
-        return self
-
-    def __exit__(self, *exc):
-        result = False
-        for cm in reversed(self._cms):
-            if cm.__exit__(*exc):
-                result = True
-        return result

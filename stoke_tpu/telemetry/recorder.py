@@ -20,6 +20,9 @@ triage without re-running:
         goodput.json        goodput/utilization summary (when attribution
                             is on — ISSUE 4)
         cost_cards.json     last analyzed per-program CostCards (ditto)
+        trace.json          the structured-trace span ring as Perfetto-
+                            loadable trace-event JSON (when tracing is
+                            on — ISSUE 10)
         stacks.txt          faulthandler all-thread stacks at dump time
 
 Bundles are cheap (the ring is small) and atomic enough for crash paths:
@@ -89,6 +92,7 @@ class FlightRecorder:
         goodput_fn: Optional[Callable[[], Optional[Dict[str, Any]]]] = None,
         cost_cards_fn: Optional[Callable[[], Any]] = None,
         fleet_fn: Optional[Callable[[], Optional[Dict[str, Any]]]] = None,
+        trace_fn: Optional[Callable[[], Any]] = None,
     ):
         self.bundle_dir = bundle_dir
         self._ring: "deque[dict]" = deque(maxlen=int(ring_size))
@@ -107,6 +111,9 @@ class FlightRecorder:
         # ISSUE 5: which host was slow at time of death — the latest
         # per-host fleet matrix + straggler verdict join every bundle
         self._fleet_fn = fleet_fn
+        # ISSUE 10: what the host was doing at time of death — the span
+        # ring as Perfetto-loadable trace.json joins every bundle
+        self._trace_fn = trace_fn
         self.dumps: List[str] = []
         self._prev_handlers: Dict[int, Any] = {}
         if install_signal_handlers:
@@ -214,6 +221,15 @@ class FlightRecorder:
                 fleet = self._fleet_fn()
                 if fleet is not None:
                     self._write_json(path, "fleet.json", fleet)
+            except Exception:
+                pass
+        if self._trace_fn is not None:
+            try:
+                events = self._trace_fn()
+                if events:
+                    self._write_json(
+                        path, "trace.json", {"traceEvents": events}
+                    )
             except Exception:
                 pass
         self._write_stacks(path)
