@@ -7,7 +7,7 @@
 CPU_ENV = env PYTHONPATH=$(CURDIR) JAX_PLATFORMS=cpu
 MESH_ENV = $(CPU_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-full test-fast test-telemetry test-collectives test-health test-attribution test-fleet test-autotune test-resilience test-zero test-serving test-tracing autotune-smoke dryrun bench-smoke telemetry-smoke serve-smoke tpu-probe
+.PHONY: test test-full test-fast test-telemetry test-collectives test-health test-attribution test-fleet test-autotune test-resilience test-zero test-serving test-tracing test-numerics autotune-smoke dryrun bench-smoke telemetry-smoke serve-smoke tpu-probe
 
 test:            ## default tier (excludes @slow compile-heavy equivalence tests)
 	$(MESH_ENV) python -m pytest tests/ -x -q
@@ -50,6 +50,9 @@ test-serving:    ## serving-stack tests only (paged KV decode parity/continuous 
 
 test-tracing:    ## structured-tracing tests only (span ring/nesting/Perfetto schema/request timelines/rank merge)
 	$(MESH_ENV) python -m pytest tests/ -x -q -m tracing
+
+test-numerics:   ## per-layer numerics tests only (module groups/provenance/quant attribution/diff tool)
+	$(MESH_ENV) python -m pytest tests/ -x -q -m numerics
 
 serve-smoke:     ## CPU-safe continuous-batching serve smoke (Poisson trace, never touches the tunnel)
 	$(CPU_ENV) python bench.py --preset tiny --serve

@@ -169,6 +169,7 @@ def _emit_persisted(metric: str, capture_error: str,
                         "ttft_p50_s", "ttft_p99_s", "tpot_p50_s",
                         "tpot_p99_s", "batch_fill_mean",
                         "kv_occupancy_peak", "quant_compression",
+                        "quant_err_max", "quant_err_layer",
                     )
                 }
                 if rec.get("serve")
@@ -205,7 +206,7 @@ REGRESSION_TOLERANCE = 0.05
 _REGRESSION_CONFIG_KEYS = (
     "xla_flags", "steps_per_dispatch", "comm_dtype", "comm_shard_tier",
     "health", "attribution", "fleet", "tuned", "resilience", "trace",
-    "serve", "serve_quant", "serve_max_seqs",
+    "numerics", "serve", "serve_quant", "serve_max_seqs",
 )
 
 
@@ -568,6 +569,13 @@ def _serve_bench(args, tiny: bool) -> int:
         "kv_occupancy_peak": round(measured["kv_occupancy_peak"], 4),
         "kv_occupancy_final": eng.allocator.occupancy,
         "quant_compression": round(eng.quant_stats["compression"], 4),
+        # per-layer dequant-error attribution (ISSUE 12): which module
+        # bounds int8 quality in this capture (None without quantization)
+        "quant_err_max": (
+            None if eng.quant_err_max is None
+            else round(eng.quant_err_max, 6)
+        ),
+        "quant_err_layer": eng.quant_err_layer,
         "on_accelerator": on_accel,
         "fresh": True,
         "measured_on": time.strftime("%Y-%m-%d"),
@@ -611,6 +619,8 @@ def _serve_bench(args, tiny: bool) -> int:
                 "batch_fill_mean": result["batch_fill_mean"],
                 "kv_occupancy_peak": result["kv_occupancy_peak"],
                 "quant_compression": result["quant_compression"],
+                "quant_err_max": result["quant_err_max"],
+                "quant_err_layer": result["quant_err_layer"],
             },
             keep_best=True,
         )
@@ -713,6 +723,18 @@ def main():
                     "trace_overhead_ok records the verdict.  A distinct "
                     "configuration for the stale-substitution and "
                     "regression guards")
+    ap.add_argument("--numerics", action="store_true",
+                    help="per-layer numerics arm (ISSUE 12): the measured "
+                    "run computes the per-module group-stats matrix "
+                    "inside every step program and fetches it per "
+                    "boundary; an off-control facade (same compiled "
+                    "APIs, NumericsConfig dropped) is measured in "
+                    "interleaved adjacent pairs (the PR-10 discipline — "
+                    "sequential arms drown a sub-2%% signal in warm-up "
+                    "drift) and numerics_overhead_frac / "
+                    "numerics_overhead_ok (< 2%%) record the verdict.  A "
+                    "distinct configuration for the stale-substitution "
+                    "and regression guards")
     ap.add_argument("--resilience", action="store_true",
                     help="enable pod-scale resilience (ISSUE 7) on the "
                     "measured run: preemption signal handlers, per-save "
@@ -820,6 +842,7 @@ def main():
                 "health": True if args.health else None,
                 "resilience": True if args.resilience else None,
                 "trace": True if args.trace else None,
+                "numerics": True if args.numerics else None,
                 "attribution": (
                     True if args.attribution_peak_tflops else None
                 ),
@@ -898,6 +921,13 @@ def main():
     variables = init_module(
         model, jax.random.PRNGKey(0), np.zeros((2, 32, 32, 3), np.float32), train=False
     )
+    # host copy for the --numerics off-control facade: the engine DONATES
+    # its state buffers, so by the time the control is constructed the
+    # original init arrays may already be deleted
+    off_variables = (
+        jax.tree_util.tree_map(np.asarray, variables)
+        if args.numerics else None
+    )
     run_configs = []
     shard_tier = args.comm_shard_tier
     if args.comm_dtype:
@@ -909,7 +939,8 @@ def main():
             dtype=args.comm_dtype,
             shard_updates=True if shard_tier == "oss" else None,
         ))
-    if args.health or args.attribution_peak_tflops or args.fleet:
+    if (args.health or args.attribution_peak_tflops or args.fleet
+            or args.numerics):
         # health (ISSUE 3) / attribution (ISSUE 4) / fleet (ISSUE 5) arms
         # all ride the telemetry pipeline (status-validated requirement)
         # — JSONL only, quiet cadence, no device-time sampling, so the
@@ -927,6 +958,13 @@ def main():
         from stoke_tpu import HealthConfig
 
         run_configs.append(HealthConfig(dump_signals=False))
+    if args.numerics:
+        # numerics arm (ISSUE 12): the per-module group-stats matrix is
+        # computed inside every step program of the measured run; the
+        # off-control pair below isolates its cost
+        from stoke_tpu import NumericsConfig
+
+        run_configs.append(NumericsConfig())
     if args.attribution_peak_tflops:
         # attribution arm (ISSUE 4): CostCards + live MFU + goodput
         # ledger observe the measured run; the ledger descriptor records
@@ -981,31 +1019,41 @@ def main():
         run_configs.append(CompileConfig(
             cache_dir=os.path.join(_REPO, "artifacts", "compile_cache"),
         ))
-    stoke = Stoke(
-        model=model,
-        optimizer=StokeOptimizer(
-            optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.05, "momentum": 0.9}
-        ),
-        loss=lambda logits, labels: optax.softmax_cross_entropy_with_integer_labels(
-            logits, labels
-        ).mean(),
-        params=variables,
-        batch_size_per_device=batch,
-        device="tpu" if on_accel else "cpu",
-        # the transport needs the distributed engine (status rule); on one
-        # chip the mesh is 1-wide and the arm measures quantize overhead
-        distributed="dp" if args.comm_dtype else None,
-        # ISSUE 8 tier arm: the sharded weight-update path engages
-        # automatically under sddp/fsdp (CommConfig.shard_updates auto)
-        oss=shard_tier in ("oss", "sddp"),
-        sddp=shard_tier == "sddp",
-        fsdp=shard_tier == "fsdp",
-        precision=None if tiny else "bf16",
-        configs=run_configs or None,
-        model_train_kwargs={"train": True},
-        model_eval_kwargs={"train": False},
-        verbose=False,
-    )
+    def _build_stoke(params_in, cfgs):
+        """ONE construction shared by the measured facade and the
+        --numerics off-control: the two arms of the interleaved overhead
+        pair must differ in their config list ONLY, or the comparison
+        silently measures two different configurations."""
+        return Stoke(
+            model=model,
+            optimizer=StokeOptimizer(
+                optimizer=optax.sgd,
+                optimizer_kwargs={"learning_rate": 0.05, "momentum": 0.9},
+            ),
+            loss=lambda logits, labels:
+                optax.softmax_cross_entropy_with_integer_labels(
+                    logits, labels
+                ).mean(),
+            params=params_in,
+            batch_size_per_device=batch,
+            device="tpu" if on_accel else "cpu",
+            # the transport needs the distributed engine (status rule); on
+            # one chip the mesh is 1-wide and the arm measures quantize
+            # overhead
+            distributed="dp" if args.comm_dtype else None,
+            # ISSUE 8 tier arm: the sharded weight-update path engages
+            # automatically under sddp/fsdp (CommConfig.shard_updates auto)
+            oss=shard_tier in ("oss", "sddp"),
+            sddp=shard_tier == "sddp",
+            fsdp=shard_tier == "fsdp",
+            precision=None if tiny else "bf16",
+            configs=cfgs or None,
+            model_train_kwargs={"train": True},
+            model_eval_kwargs={"train": False},
+            verbose=False,
+        )
+
+    stoke = _build_stoke(variables, run_configs)
 
     # Pre-place a rotating pool of device batches: this measures the training
     # step itself (host->HBM transfer overlap is the DataLoader's job and the
@@ -1021,10 +1069,9 @@ def main():
         per_call = SEG
         steps = max(3, steps // SEG)
         warmup = min(warmup, 1)  # each warmup call is already SEG steps
-
-        def one_step(i):
-            return stoke.train_steps(xs, (ys,))
+        pool = None
     else:
+        xs = ys = None
         pool = [
             (
                 jax.device_put(r.normal(size=(batch, 32, 32, 3)).astype(np.float32)),
@@ -1033,25 +1080,42 @@ def main():
             for _ in range(4)
         ]
 
-        def one_step(i):
+    def _make_step(facade):
+        """ONE step driver shared by the measured facade and the
+        --numerics off-control — both arms must run the SAME api path
+        over the SAME pre-placed batch pool, or the interleaved pair
+        compares two different step programs."""
+        if api == "train_steps":
+            return lambda i: facade.train_steps(xs, (ys,))
+
+        def step_fn(i):
             x, y = pool[i % len(pool)]
             if api == "train_step":
-                return stoke.train_step(x, (y,))
-            out = stoke.model(x)
-            loss = stoke.loss(out, y)
-            stoke.backward(loss)
-            stoke.step()
+                return facade.train_step(x, (y,))
+            out = facade.model(x)
+            loss = facade.loss(out, y)
+            facade.backward(loss)
+            facade.step()
             return loss
 
-    def timed(n):
-        """Wall time for n steps with a forced device fetch at the end
-        (block_until_ready is unreliable through remote-device tunnels)."""
-        t0 = time.perf_counter()
-        last = None
-        for i in range(n):
-            last = one_step(i)
-        np.asarray(jax.tree_util.tree_leaves(last)[0])  # real sync: fetch scalar
-        return time.perf_counter() - t0
+        return step_fn
+
+    def _make_timed(step_fn):
+        def timed_fn(n):
+            """Wall time for n steps with a forced device fetch at the
+            end (block_until_ready is unreliable through remote-device
+            tunnels)."""
+            t0 = time.perf_counter()
+            last = None
+            for i in range(n):
+                last = step_fn(i)
+            np.asarray(jax.tree_util.tree_leaves(last)[0])  # real sync
+            return time.perf_counter() - t0
+
+        return timed_fn
+
+    one_step = _make_step(stoke)
+    timed = _make_timed(one_step)
 
     for i in range(warmup):
         one_step(i)
@@ -1060,6 +1124,54 @@ def main():
     t1 = timed(steps)
     t2 = timed(2 * steps)
     dt = max(t2 - t1, 1e-9)
+
+    numerics_overhead_frac = None
+    if args.numerics:
+        # numerics-off control: a SECOND facade with identical model /
+        # optimizer / tier / step API whose programs simply omit the
+        # group-stats matrix (NumericsConfig dropped; its TelemetryConfig
+        # gets its own sink dir so the two JSONL streams never collide).
+        # Unlike tracing, the matrix is compiled INTO the program, so the
+        # control must be a separate compiled facade — but the interleaved
+        # adjacent-pair discipline (ISSUE 10) is the same: drift hits both
+        # sides of a pair equally, first pair discarded, median reported.
+        # The headline dt above stays untouched.
+        import tempfile
+
+        from stoke_tpu import NumericsConfig, TelemetryConfig
+
+        off_configs = [
+            TelemetryConfig(
+                output_dir=tempfile.mkdtemp(prefix="stoke-bench-numoff-"),
+                log_every_n_steps=10, prometheus=False, tensorboard=False,
+                sample_device_time=False,
+            )
+            if isinstance(c, TelemetryConfig)
+            else c
+            for c in run_configs
+            if not isinstance(c, NumericsConfig)
+        ]
+        stoke_off = _build_stoke(off_variables, off_configs)
+        off_step = _make_step(stoke_off)
+        timed_off = _make_timed(off_step)
+
+        for i in range(max(warmup, 1)):
+            off_step(i)
+        timed_off(1)
+        timed(steps)  # settle before the paired windows
+        fracs = []
+        for i in range(7):
+            if i % 2 == 0:
+                d_on = timed(steps)
+                d_off = timed_off(steps)
+            else:
+                d_off = timed_off(steps)
+                d_on = timed(steps)
+            fracs.append((d_on - d_off) / d_off)
+        fracs = sorted(fracs[1:])  # discard the warm-up pair
+        mid = len(fracs) // 2
+        numerics_overhead_frac = max(0.0, (fracs[mid - 1] + fracs[mid]) / 2)
+        stoke_off.close_telemetry()
 
     trace_overhead_frac = None
     if args.trace:
@@ -1200,6 +1312,29 @@ def main():
                 f"(claim is < 1%)",
                 file=sys.stderr,
             )
+    if args.numerics:
+        # numerics columns (ISSUE 12): the per-layer observatory's cost
+        # verdict against the off-control, plus which layers the measured
+        # run ranked noisiest — the ledger's "where would I bisect first"
+        ns = stoke.numerics_summary or {}
+        result["numerics"] = True
+        result["numerics_groups"] = len(ns.get("groups") or [])
+        result["numerics_overhead_frac"] = round(numerics_overhead_frac, 6)
+        result["numerics_overhead_ok"] = numerics_overhead_frac < 0.02
+        result["numerics_top_noise"] = [
+            {"group": t["group"], "noise": round(t["noise"], 6)}
+            for t in (ns.get("top_grad_noise") or [])[:3]
+        ]
+        result["numerics_provenance_events"] = len(
+            ns.get("provenance_events") or []
+        )
+        if not result["numerics_overhead_ok"]:
+            print(
+                f"bench.py NUMERICS OVERHEAD: numerics-on arm ran "
+                f"{numerics_overhead_frac:.2%} slower than numerics-off "
+                f"(claim is < 2%)",
+                file=sys.stderr,
+            )
     if args.resilience:
         # resilience columns (ISSUE 7): the restart/resume accounting of
         # the measured run — quiet here (nothing preempts a bench), but
@@ -1222,7 +1357,7 @@ def main():
         result["cache_miss"] = cc.misses
         result["cache_saved_compile_s"] = round(cc.saved_compile_s, 3)
     if (args.health or args.attribution_peak_tflops or args.fleet
-            or args.resilience or args.trace):
+            or args.resilience or args.trace or args.numerics):
         stoke.close_telemetry()
     if on_accel:
         regression = check_regression(
@@ -1241,6 +1376,7 @@ def main():
                 "fleet": True if args.fleet else None,
                 "resilience": True if args.resilience else None,
                 "trace": True if args.trace else None,
+                "numerics": True if args.numerics else None,
             },
         )
         if regression is not None:
@@ -1331,6 +1467,20 @@ def main():
                         "trace_spans": result["trace_spans"],
                     }
                     if args.trace
+                    else {}
+                ),
+                **(
+                    {
+                        "numerics": True,
+                        "numerics_groups": result["numerics_groups"],
+                        "numerics_overhead_frac": result[
+                            "numerics_overhead_frac"
+                        ],
+                        "numerics_overhead_ok": result[
+                            "numerics_overhead_ok"
+                        ],
+                    }
+                    if args.numerics
                     else {}
                 ),
                 **(

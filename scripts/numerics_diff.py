@@ -1,0 +1,197 @@
+"""Align two runs' telemetry JSONL by step and print the per-layer drift.
+
+The offline half of the per-layer numerics observatory (ISSUE 12): two
+runs with ``NumericsConfig(per_group_jsonl=True)`` leave ``numerics/
+per_group`` blocks in their ``steps.jsonl``; this tool aligns the two
+streams by optimizer step and prints, per module group, how far run B's
+per-layer statistics drift from run A's — the fp32-vs-int8 quality
+bisection ("which layer does the quantized wire hurt?") and the
+run-vs-run divergence bisection ("which layer moved first?") in one
+table.  Pure file work; never touches an accelerator.
+
+Usage (CPU-safe):
+
+    env PYTHONPATH=. JAX_PLATFORMS=cpu \
+        python scripts/numerics_diff.py <run_a> <run_b> [--json]
+        [--stat grad_rms] [--top 0] [--no-validate]
+
+``<run>`` is a telemetry output dir (``steps.jsonl`` / rank-0 stream
+inside) or an explicit jsonl file.  Drift per group is reported at the
+LAST aligned step (where divergence is largest) plus the worst step seen;
+``rel`` is ``|b - a| / (|a| + eps)``.  Exit 0 on a clean diff, 2 when the
+streams share no step carrying a per-group block on both sides —
+"nothing aligned", mirroring ``merge_rank_jsonl.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_EPS = 1e-12
+
+
+def resolve_stream(path: str) -> str:
+    """A run dir resolves to its ``steps.jsonl`` (or the rank-0 stream of
+    an all-ranks run); an explicit file passes through."""
+    if os.path.isdir(path):
+        for name in ("steps.jsonl", "steps.rank0.jsonl"):
+            candidate = os.path.join(path, name)
+            if os.path.exists(candidate):
+                return candidate
+        raise FileNotFoundError(
+            f"{path}: no steps.jsonl / steps.rank0.jsonl inside"
+        )
+    return path
+
+
+def load_numerics(
+    path: str, validate: bool
+) -> Dict[int, Dict[str, Dict[str, float]]]:
+    """``{step: per_group_block}`` for records carrying one."""
+    from stoke_tpu.telemetry.events import read_step_events
+
+    out: Dict[int, Dict[str, Dict[str, float]]] = {}
+    for rec in read_step_events(path, validate=validate):
+        block = rec.get("numerics/per_group")
+        if block:
+            out[int(rec["step"])] = block
+    return out
+
+
+def diff_runs(
+    a: Dict[int, Dict[str, Dict[str, float]]],
+    b: Dict[int, Dict[str, Dict[str, float]]],
+    stat: str,
+) -> Dict[str, Any]:
+    """Per-group drift over the aligned steps.
+
+    Groups present in only one run are reported (``only_in``) rather than
+    silently dropped — a missing group IS the drift when comparing a
+    refactored model.  Per aligned group: the compared stat's values and
+    relative drift at the last aligned step, and the worst drift over all
+    aligned steps (with the step it peaked at).
+    """
+    steps = sorted(set(a) & set(b))
+    groups_a = set().union(*(set(v) for v in a.values())) if a else set()
+    groups_b = set().union(*(set(v) for v in b.values())) if b else set()
+    shared = sorted(groups_a & groups_b)
+    rows: List[Dict[str, Any]] = []
+    for group in shared:
+        last = None
+        worst: Optional[Tuple[float, int, float, float]] = None
+        for step in steps:
+            va = (a[step].get(group) or {}).get(stat)
+            vb = (b[step].get(group) or {}).get(stat)
+            if va is None or vb is None:
+                continue
+            rel = abs(vb - va) / (abs(va) + _EPS)
+            last = {"step": step, "a": va, "b": vb, "rel": rel}
+            if worst is None or rel > worst[0]:
+                worst = (rel, step, va, vb)
+        if last is None:
+            continue
+        rows.append({
+            "group": group,
+            "last_step": last["step"],
+            "a": last["a"],
+            "b": last["b"],
+            "rel": last["rel"],
+            "worst_rel": worst[0],
+            "worst_step": worst[1],
+        })
+    rows.sort(key=lambda r: r["worst_rel"], reverse=True)
+    return {
+        "stat": stat,
+        "aligned_steps": len(steps),
+        "steps": steps,
+        "groups": shared,
+        "only_in_a": sorted(groups_a - groups_b),
+        "only_in_b": sorted(groups_b - groups_a),
+        "rows": rows,
+    }
+
+
+def print_table(report: Dict[str, Any], top: int) -> None:
+    stat = report["stat"]
+    hdr = (
+        f"{'group':<24} {'a:' + stat:>14} {'b:' + stat:>14} "
+        f"{'rel_drift':>10} {'worst':>10} {'@step':>6}"
+    )
+    print(hdr)
+    print("-" * len(hdr))
+    rows = report["rows"][:top] if top else report["rows"]
+    for r in rows:
+        print(
+            f"{r['group']:<24} {r['a']:>14.6g} {r['b']:>14.6g} "
+            f"{100 * r['rel']:>9.2f}% {100 * r['worst_rel']:>9.2f}% "
+            f"{r['worst_step']:>6}"
+        )
+    print()
+    print(
+        f"{report['aligned_steps']} aligned steps, "
+        f"{len(report['groups'])} shared groups"
+    )
+    for side in ("a", "b"):
+        only = report[f"only_in_{side}"]
+        if only:
+            print(f"  groups only in run {side}: {', '.join(only)}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="align two runs' numerics/per_group JSONL blocks by "
+        "step and print the per-layer drift table (fp32-vs-int8 or "
+        "run-vs-run bisection)"
+    )
+    ap.add_argument("run_a", help="telemetry output dir or jsonl file")
+    ap.add_argument("run_b", help="telemetry output dir or jsonl file")
+    ap.add_argument("--stat", default="grad_rms",
+                    help="per-group stat to diff (grad_rms, grad_absmax, "
+                    "param_rms, update_rms, nonfinite, wire_err, "
+                    "quant_err; default grad_rms)")
+    ap.add_argument("--top", type=int, default=0,
+                    help="print only the N worst-drifting groups "
+                    "(0 = all)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as one JSON document")
+    ap.add_argument("--no-validate", action="store_true",
+                    help="skip step-event schema validation (salvaging "
+                    "truncated streams from dead runs)")
+    args = ap.parse_args(argv)
+
+    streams = []
+    for path in (args.run_a, args.run_b):
+        try:
+            resolved = resolve_stream(path)
+            streams.append(load_numerics(resolved, not args.no_validate))
+        except (OSError, ValueError) as e:
+            print(f"{path}: {e}", file=sys.stderr)
+            return 2
+    a, b = streams
+    report = diff_runs(a, b, args.stat)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print_table(report, args.top)
+    if report["aligned_steps"] == 0 or not report["rows"]:
+        # no step carries a per-group block in BOTH streams (disjoint
+        # cadences, numerics off in one run, or the requested stat absent
+        # everywhere) — "nothing could be aligned" is the documented
+        # nonzero-exit condition, mirroring merge_rank_jsonl.py
+        print(
+            "no step carries a numerics/per_group block (with the "
+            "requested stat) in both runs; nothing aligned",
+            file=sys.stderr,
+        )
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,8 +15,12 @@ fields in the JSONL, >= 3.5x compression asserted, blocks drained back
 to the pool); since ISSUE 10, one traced train window + one traced serve
 request (the exported trace.rank0.json files must parse as chrome-trace
 JSON and carry engine step spans AND a full per-request
-admission->prefill->decode timeline).  Prints the step record and a
-one-line verdict; exit 0 only when everything round-trips.
+admission->prefill->decode timeline); since ISSUE 12, a per-layer
+numerics window (per-group JSONL block, a NaN injected into a known
+layer attributed to that group's index in record + anomaly, and an
+offline numerics_diff.py alignment of two smoke JSONLs).  Prints the
+step record and a one-line verdict; exit 0 only when everything
+round-trips.
 """
 
 from __future__ import annotations
@@ -37,6 +41,7 @@ def main() -> int:
         AttributionConfig,
         FleetConfig,
         HealthConfig,
+        NumericsConfig,
         Stoke,
         StokeOptimizer,
         TelemetryConfig,
@@ -67,6 +72,9 @@ def main() -> int:
     # window below; the exported trace.rank0.json is parsed at the end
     tr_dir = os.path.join(out_dir, "trace")
     trcfg = TraceConfig(output_dir=tr_dir, ring_size=512)
+    # per-layer numerics (ISSUE 12): the group-stats matrix rides the
+    # same compiled step; the per-group block is asserted on the record
+    nmcfg = NumericsConfig()
     stoke = Stoke(
         model=lambda p, x: x @ p["w"],
         optimizer=StokeOptimizer(
@@ -75,7 +83,7 @@ def main() -> int:
         loss=lambda o, y: ((o - y) ** 2).mean(),
         params={"w": np.ones((8, 4), np.float32)},
         batch_size_per_device=16,
-        configs=[cfg, hcfg, acfg, fcfg, trcfg],
+        configs=[cfg, hcfg, acfg, fcfg, trcfg, nmcfg],
         verbose=False,
     )
     x = np.ones((16, 8), np.float32)
@@ -293,6 +301,86 @@ def main() -> int:
         and "stoke_serve_kv_block_occupancy" in sv_prom
     )
 
+    # per-layer numerics observatory (ISSUE 12): two runs of a TWO-group
+    # model — one clean, one with a NaN injected into the SECOND layer's
+    # gradients only (the loss is separable, so lay_a's gradients stay
+    # finite) — asserting the per-group JSONL block, a non-empty summary,
+    # the NaN attributed to lay_b's group index in record AND anomaly,
+    # and an offline numerics_diff.py alignment of the two JSONLs
+    import subprocess
+
+    nm_a_dir = os.path.join(out_dir, "numerics_a")
+    nm_b_dir = os.path.join(out_dir, "numerics_b")
+
+    def _nm_run(nm_dir, inject_nan):
+        s = Stoke(
+            model=lambda p, x: (p["lay_a"]["w"] * x[:, :4, None]).sum()
+            + (p["lay_b"]["w"] * x[:, 4:, None]).sum(),
+            optimizer=StokeOptimizer(
+                optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.0}
+            ),
+            loss=lambda o: o,
+            params={
+                "lay_a": {"w": np.ones((4, 3), np.float32)},
+                "lay_b": {"w": np.ones((4, 3), np.float32)},
+            },
+            batch_size_per_device=8,
+            configs=[
+                TelemetryConfig(
+                    output_dir=nm_dir, log_every_n_steps=1,
+                    prometheus=False, tensorboard=False,
+                    sample_device_time=False, track_hbm=False,
+                ),
+                HealthConfig(dump_signals=False),
+                NumericsConfig(),
+            ],
+            verbose=False,
+        )
+        nx = np.ones((8, 8), np.float32)
+        s.train_step(nx, ())
+        nx2 = nx.copy()
+        if inject_nan:
+            nx2[:, 5] = np.nan  # only lay_b's gradient sees it
+        s.train_step(nx2, ())
+        s.close_telemetry()
+        return s
+
+    nm_clean = _nm_run(nm_a_dir, inject_nan=False)
+    nm_nan = _nm_run(nm_b_dir, inject_nan=True)
+    nm_rec = read_step_events(os.path.join(nm_b_dir, "steps.jsonl"))[-1]
+    nm_clean_rec = read_step_events(
+        os.path.join(nm_a_dir, "steps.jsonl")
+    )[-1]
+    nm_summary = nm_nan.numerics_summary or {}
+    nm_anomalies = {
+        a.detector for a in (nm_nan.health.anomalies if nm_nan.health else [])
+    }
+    diff_proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "numerics_diff.py"),
+         nm_a_dir, nm_b_dir, "--json", "--stat", "update_rms"],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        diff_report = json.loads(diff_proc.stdout)
+    except ValueError:
+        diff_report = {}
+    numerics_ok = (
+        (nm_rec.get("numerics/per_group") or {}).keys()
+        == {"lay_a", "lay_b"}
+        and nm_rec.get("numerics/provenance_group") == 1
+        and nm_rec.get("numerics/provenance_name") == "lay_b"
+        and nm_rec.get("numerics/provenance_field") == "grad"
+        and nm_clean_rec.get("numerics/provenance_group") is None
+        and "numerics_provenance" in nm_anomalies
+        and bool(nm_summary.get("top_grad_noise"))
+        and diff_proc.returncode == 0
+        and diff_report.get("aligned_steps", 0) >= 2
+        and set(diff_report.get("groups") or []) == {"lay_a", "lay_b"}
+    )
+
     # structured tracing (ISSUE 10): both exported traces must parse as
     # chrome-trace JSON; the train trace must carry engine step spans,
     # the serve trace at least one full request timeline — admission,
@@ -365,6 +453,8 @@ def main() -> int:
         "fleet.json",
         # ISSUE 10: what the host was doing at time of death
         "trace.json",
+        # ISSUE 12: which layer was bad at time of death
+        "numerics.json",
     } <= bundle_files
     ring_kinds = set()
     if bundle_ok:
@@ -398,9 +488,15 @@ def main() -> int:
         and zero_ok
         and serving_ok
         and tracing_ok
+        and numerics_ok
+        # ISSUE 12: the main run's record carries the per-group block
+        # (one group: the single "w" param)
+        and (rec.get("numerics/per_group") or {}).keys() == {"w"}
         # default-OFF discipline (ISSUE 9): training records never carry
-        # serve fields
+        # serve fields — and (ISSUE 12) a run without a NumericsConfig
+        # (the serve cycle's) never carries numerics fields
         and not any(k.startswith("serve/") for k in rec)
+        and not any(k.startswith("numerics/") for k in sv_rec)
     )
     print(json.dumps({
         "telemetry_smoke": "ok" if ok else "FAILED",
@@ -428,6 +524,9 @@ def main() -> int:
         "serve_ttft_p50_s": sv_rec.get("serve/ttft_p50_s"),
         "serve_tpot_p50_s": sv_rec.get("serve/tpot_p50_s"),
         "serve_quant_compression": sv_rec.get("serve/quant_compression"),
+        "numerics": "ok" if numerics_ok else "FAILED",
+        "numerics_provenance": nm_rec.get("numerics/provenance_name"),
+        "numerics_diff_aligned": diff_report.get("aligned_steps"),
         "tracing": "ok" if tracing_ok else "FAILED",
         "trace_train_spans": len(train_trace),
         "trace_serve_spans": len(serve_trace),

@@ -24,6 +24,7 @@ from stoke_tpu.configs import (
     HealthConfig,
     LossReduction,
     MeshConfig,
+    NumericsConfig,
     OffloadDiskConfig,
     OffloadOptimizerConfig,
     OffloadParamsConfig,
@@ -98,6 +99,7 @@ __all__ = [
     "FleetConfig",
     "FSDPConfig",
     "HealthConfig",
+    "NumericsConfig",
     "OffloadDiskConfig",
     "OffloadOptimizerConfig",
     "OffloadParamsConfig",

@@ -999,6 +999,65 @@ class FleetConfig:
 
 
 @dataclass
+class NumericsConfig:
+    """Per-layer numerics observatory (ISSUE 12 tentpole): module
+    sentinels, NaN provenance, and quantization-error attribution.
+
+    Requires a :class:`TelemetryConfig` (the per-layer view surfaces
+    through the JSONL step events and Prometheus exposition;
+    status-validated).  Default OFF — without this config the compiled
+    step programs are bit-identical, no ``numerics/*`` JSONL field or
+    registry gauge exists, and the step paths are untouched.
+
+    With it on, the compiled apply additionally returns one fixed-layout
+    ``[n_groups, n_stats]`` f32 matrix of per-top-level-module raw sums
+    (grad sum-of-squares / absmax / nonfinite-element count, param and
+    update sum-of-squares — ``stoke_tpu.telemetry.numerics
+    .NUMERICS_STATS``, a wire format) computed *inside* the existing
+    step program — the PR-3 sentinel discipline: zero extra device
+    dispatches, the matrix is fetched with the existing sentinel row.
+    Host-side, the :class:`~stoke_tpu.telemetry.numerics
+    .NumericsMonitor` derives per-group rms views (which recombine
+    exactly to the global grad-norm sentinel), first-offending-layer
+    NaN/Inf provenance (a ``numerics_provenance`` health detector when a
+    ``HealthConfig`` is present), per-layer wire error for the PR-8
+    sharded transport (per-bucket error-feedback residual norms mapped
+    back to module groups), and per-layer dequant error for PR-9
+    int8-served weights.  Outputs: ``numerics/*`` registry gauges, a
+    nullable per-group JSONL block, ``Stoke.numerics_summary``,
+    ``numerics.json`` in flight-recorder bundles, and the offline
+    ``scripts/numerics_diff.py`` run-vs-run drift table.
+
+    Attributes:
+        grad_stats: compile the per-group stats matrix into every step
+            path (the tentpole signal; False leaves the compiled
+            programs untouched and keeps only the host-side
+            quantization-error attribution).
+        provenance_action: health-detector action when a non-finite
+            value is first attributed to a layer — ``record`` / ``warn``
+            / ``dump`` / ``halt`` (validated against ``HEALTH_ACTIONS``;
+            ``halt`` is illegal under fp16, whose scaler tolerates
+            transient infs by skipping the step).  Without a
+            ``HealthConfig`` the action degrades to a bounded warning.
+        wire_error: at the telemetry cadence, fetch the gradient
+            transport's error-feedback residual norms and attribute them
+            to module groups (one tiny host fetch per logged window; a
+            no-op without a ``CommConfig`` carrying error feedback).
+        per_group_jsonl: emit the per-group block into the JSONL step
+            events (the ``numerics_diff.py`` input; scalar provenance /
+            quant-error fields ride regardless).
+        top_k: groups ranked in ``Stoke.numerics_summary`` (>= 1;
+            status-validated).
+    """
+
+    grad_stats: bool = True
+    provenance_action: str = "warn"
+    wire_error: bool = True
+    per_group_jsonl: bool = True
+    top_k: int = 5
+
+
+@dataclass
 class ResilienceConfig:
     """Pod-scale resilience (ISSUE 7 tentpole): preemption-aware emergency
     checkpointing, integrity-verified auto-resume with quarantine, and the
@@ -1327,6 +1386,7 @@ ALL_CONFIG_CLASSES: Tuple[type, ...] = (
     CheckpointConfig,
     FleetConfig,
     HealthConfig,
+    NumericsConfig,
     ProfilerConfig,
     ResilienceConfig,
     ServeConfig,

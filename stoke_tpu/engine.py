@@ -52,6 +52,7 @@ from stoke_tpu.parallel.zero import make_transport
 from stoke_tpu.parallel.sharding import ShardingRules, place_global_tree
 from stoke_tpu.telemetry.tracing import trace_span
 from stoke_tpu.telemetry.health import compute_sentinels
+from stoke_tpu.telemetry.numerics import compute_group_stats
 from stoke_tpu.utils.trees import tree_cast, tree_finite, tree_zeros_like
 
 
@@ -403,6 +404,7 @@ class StepEngine:
         aux_loss_weight: float = 0.01,
         comm: Optional[Any] = None,
         health: Optional[Any] = None,
+        numerics: Optional[Any] = None,
     ):
         self.adapter = adapter
         self.loss_fn = loss_fn
@@ -437,6 +439,16 @@ class StepEngine:
         self.health = health
         self.sentinels_enabled = bool(
             health is not None and getattr(health, "sentinels", False)
+        )
+        # per-layer numerics observatory (ISSUE 12): when on, the apply
+        # core additionally returns a fixed-layout [n_groups, n_stats]
+        # group-stats matrix computed INSIDE the same compiled program —
+        # the sentinel discipline again: zero extra dispatches, and a
+        # None slot (empty pytree) when off keeps the compiled programs
+        # bit-identical to a build without the feature.
+        self.numerics = numerics
+        self.numerics_enabled = bool(
+            numerics is not None and getattr(numerics, "grad_stats", False)
         )
         # compiled-program invocation counter: one increment per device
         # dispatch issued by this engine.  The health acceptance criterion
@@ -1061,8 +1073,9 @@ class StepEngine:
         Stacked args carry the micro dimension on axis 0 (leaf shape
         [k, micro_batch, ...]).  Returns (reports_stacked, variables,
         opt_state, grad_buf, scaler_state, comm_state, rng, sentinels,
-        finite) — ``sentinels`` is the health diagnostics vector (None when
-        sentinels are off).
+        numerics, finite) — ``sentinels`` is the health diagnostics vector
+        and ``numerics`` the per-group stats matrix (each None when its
+        feature is off).
         """
         key = (
             "window",
@@ -1138,11 +1151,11 @@ class StepEngine:
                 self._report_loss(reports) if self.sentinels_enabled else None
             )
             (new_vars, new_opt, zero_buf, new_scaler, new_comm, sentinels,
-             finite) = apply_core(
+             numerics, finite) = apply_core(
                 merged, opt_state, new_buf, scaler_mid, comm_state, loss_val
             )
             return (reports, new_vars, new_opt, zero_buf, new_scaler,
-                    new_comm, new_rng, sentinels, finite)
+                    new_comm, new_rng, sentinels, numerics, finite)
 
         return _window
 
@@ -1160,6 +1173,7 @@ class StepEngine:
                 self._comm_state_shardings(),
                 repl,  # rng
                 self._sentinel_shardings(),
+                self._numerics_shardings(),
                 repl,  # finite
             )
             return jax.jit(
@@ -1194,7 +1208,7 @@ class StepEngine:
         Stacked args carry [n_steps, grad_accum, micro_batch, ...] leaves.
         Returns (reports [n, k, ...], variables, opt_state, grad_buf,
         scaler_state, comm_state, rng, sentinels [n, S] (None when off),
-        n_nonfinite_steps).
+        numerics [n, G, S'] (None when off), n_nonfinite_steps).
         """
         key = (
             "multi",
@@ -1254,7 +1268,7 @@ class StepEngine:
                  skipped) = carry
                 margs, mkwargs, larr = xs  # [k, ...] micro-batches
                 (reports, new_vars, new_opt, zero_buf, new_scaler, new_comm,
-                 new_rng, sentinels, finite) = window(
+                 new_rng, sentinels, numerics, finite) = window(
                     variables, opt_state, buf, scaler_state, comm_state, rng,
                     margs, mkwargs, larr,
                 )
@@ -1262,18 +1276,18 @@ class StepEngine:
                 return (
                     (new_vars, new_opt, zero_buf, new_scaler, new_comm,
                      new_rng, skipped),
-                    (reports, sentinels),
+                    (reports, sentinels, numerics),
                 )
 
             ((vars_f, opt_f, buf_f, scaler_f, comm_f, rng_f, skipped),
-             (reports, sentinels_s)) = jax.lax.scan(
+             (reports, sentinels_s, numerics_s)) = jax.lax.scan(
                 step_body,
                 (variables, opt_state, grad_buf, scaler_state, comm_state,
                  rng, jnp.float32(0.0)),
                 (margs_s, mkwargs_s, larr_s),
             )
             return (reports, vars_f, opt_f, buf_f, scaler_f, comm_f, rng_f,
-                    sentinels_s, skipped)
+                    sentinels_s, numerics_s, skipped)
 
         if self.rules is not None:
             repl = self._repl
@@ -1286,6 +1300,7 @@ class StepEngine:
                 self._comm_state_shardings(),
                 repl,  # rng
                 self._sentinel_shardings(),  # stacked sentinel rows
+                self._numerics_shardings(),  # stacked group-stats matrices
                 repl,  # skipped count
             )
             return jax.jit(
@@ -1302,8 +1317,9 @@ class StepEngine:
         (reference step() path, stoke.py:990-1040 + fp16.py:788-806).
 
         ``loss_val``: boundary loss scalar for the health sentinels (None
-        — an empty jit input — when sentinels are off).  Returns an extra
-        sentinel-vector slot before ``finite`` (None when off)."""
+        — an empty jit input — when sentinels are off).  Returns extra
+        sentinel-vector and per-group numerics-matrix slots before
+        ``finite`` (each None when its feature is off)."""
         if self._apply_fn is None:
             self._apply_fn = self._build_apply()
         self._note_cost(
@@ -1332,6 +1348,7 @@ class StepEngine:
         optimizer = self.optimizer
         transport = self.transport
         sentinels_on = self.sentinels_enabled
+        numerics_on = self.numerics_enabled
 
         def _apply(variables, opt_state, grad_buf, scaler_state, comm_state,
                    loss_val=None):
@@ -1356,9 +1373,11 @@ class StepEngine:
             # (no CommConfig / dtype="fp32") returns grads and the empty
             # state untouched: the compiled program is unchanged.
             grads, new_comm = transport.apply(grads, comm_state)
-            # health sentinels read the unscaled post-transport gradients
-            # (pre-clip — a clipped-away spike must still be visible)
-            health_grads = grads if sentinels_on else None
+            # health sentinels AND the per-layer numerics matrix read the
+            # unscaled post-transport gradients (pre-clip — a clipped-away
+            # spike must still be visible; one shared tap point keeps the
+            # per-group sums recombining exactly to the sentinel norm)
+            health_grads = grads if (sentinels_on or numerics_on) else None
             finite = tree_finite(grads) if scaled else jnp.asarray(True)
             if per_loss:
                 # any loss overflowing anywhere in the window skips the step
@@ -1407,8 +1426,16 @@ class StepEngine:
                 if sentinels_on
                 else None
             )
+            # per-layer numerics matrix (ISSUE 12): per-module-group raw
+            # sums fused into THIS program — None (empty pytree) when off,
+            # so the default-off program is bit-identical
+            numerics = (
+                compute_group_stats(health_grads, new_params, params)
+                if numerics_on
+                else None
+            )
             return (new_vars, new_opt, zero_buf, new_scaler, new_comm,
-                    sentinels, finite)
+                    sentinels, numerics, finite)
 
         return _apply
 
@@ -1416,6 +1443,11 @@ class StepEngine:
         """out_shardings slot for the sentinel vector: replicated when on,
         None (matching the empty pytree) when off."""
         return self._repl if self.sentinels_enabled else None
+
+    def _numerics_shardings(self):
+        """out_shardings slot for the per-group numerics matrix (ISSUE
+        12): replicated when on, None (empty pytree) when off."""
+        return self._repl if self.numerics_enabled else None
 
     def _build_apply(self):
         _apply = self._apply_core()
@@ -1427,6 +1459,7 @@ class StepEngine:
                 self._scaler_shardings(),
                 self._comm_state_shardings(),
                 self._sentinel_shardings(),
+                self._numerics_shardings(),
                 self._repl,
             )
             return jax.jit(
@@ -1461,9 +1494,10 @@ class StepEngine:
         compiles the same math split across two dispatches.
 
         Returns (report, updated_nonparam_vars, variables, opt_state,
-        grad_buf, scaler_state, comm_state, rng, sentinels, finite) —
-        ``sentinels`` is the health diagnostics vector at apply boundaries
-        (None off-boundary or when sentinels are off).
+        grad_buf, scaler_state, comm_state, rng, sentinels, numerics,
+        finite) — ``sentinels``/``numerics`` are the health diagnostics
+        vector and per-group stats matrix at apply boundaries (None
+        off-boundary or when the feature is off).
         """
         key = (
             "fused",
@@ -1517,7 +1551,7 @@ class StepEngine:
                 loss_args_flat,
             )
         return (report, updated, new_vars, opt_state, new_buf, new_scaler,
-                comm_state, new_rng, None, finite)
+                comm_state, new_rng, None, None, finite)
 
     def _build_fused(self, loss_treedef, deferred_info, do_apply):
         accum = self._accum_core(loss_treedef, deferred_info, training=True)
@@ -1542,12 +1576,13 @@ class StepEngine:
                     else None
                 )
                 (new_vars, new_opt, zero_buf, new_scaler, new_comm,
-                 sentinels, finite) = apply_core(
+                 sentinels, numerics, finite) = apply_core(
                     merged, opt_state, new_buf, scaler_mid, comm_state,
                     loss_val,
                 )
                 return (report, updated, new_vars, new_opt, zero_buf,
-                        new_scaler, new_comm, new_rng, sentinels, finite)
+                        new_scaler, new_comm, new_rng, sentinels, numerics,
+                        finite)
 
             if self.rules is not None:
                 repl = self._repl
@@ -1561,6 +1596,7 @@ class StepEngine:
                     self._comm_state_shardings(),
                     repl,  # rng
                     self._sentinel_shardings(),
+                    self._numerics_shardings(),
                     repl,  # finite
                 )
                 return jax.jit(

@@ -328,6 +328,7 @@ class Stoke:
             aux_loss_weight=aux_loss_weight,
             comm=st.comm_config,
             health=st.health_config,
+            numerics=st.numerics_config,
         )
         if self._rules is not None:
             opt_shapes = jax.eval_shape(self._optimizer.init, variables["params"])
@@ -499,6 +500,8 @@ class Stoke:
         #       the step paths are untouched) -----
         self._health: Optional[HealthMonitor] = None
         self._fleet = None  # assigned below; the recorder's fleet_fn
+        self._numerics = None  # assigned below; the recorder's numerics_fn
+        self._wire_error_warned = False
         self._last_sentinels = None  # closure may fire before then
         hcfg = st.health_config
         if hcfg is not None:
@@ -546,12 +549,29 @@ class Stoke:
                     if self._tracer is not None
                     else None
                 ),
+                # ISSUE 12: late-bound like the fleet view — which LAYER
+                # was bad at time of death (numerics.json); bundles
+                # written before the monitor exists carry none
+                numerics_fn=lambda: (
+                    self._numerics.snapshot()
+                    if self._numerics is not None
+                    else None
+                ),
             )
             self._health = HealthMonitor(
                 hcfg,
                 self._telemetry.registry,
                 recorder,
                 compile_tracker=self._telemetry.compile_tracker,
+            )
+            # leaf-level NaN provenance (ISSUE 12 satellite): the sentinel
+            # row carries the first offending leaf INDEX; this table lets
+            # the NonFiniteDetector name its path even without a
+            # NumericsConfig
+            from stoke_tpu.telemetry.numerics import leaf_path_names
+
+            self._health.leaf_paths = leaf_path_names(
+                self._variables["params"]
             )
             if self._attribution is not None:
                 # the profiler auto-capture registers as a health
@@ -593,6 +613,39 @@ class Stoke:
                 self._health.detectors.append(
                     FleetStragglerDetector(
                         self._fleet, fcfg.straggler_action
+                    )
+                )
+
+        # ----- per-layer numerics observatory (ISSUE 12: module
+        #       sentinels, NaN provenance, quantization-error attribution;
+        #       default OFF — without a NumericsConfig the compiled step
+        #       programs are bit-identical and no numerics/* field or
+        #       gauge exists anywhere) -----
+        ncfg = st.numerics_config
+        if ncfg is not None:
+            from stoke_tpu.telemetry.numerics import (
+                NumericsMonitor,
+                NumericsProvenanceDetector,
+                leaf_path_names as _leaf_paths,
+                module_groups,
+            )
+
+            self._numerics = NumericsMonitor(
+                ncfg,
+                self._telemetry.registry,
+                module_groups(self._variables["params"]),
+                leaf_paths=_leaf_paths(self._variables["params"]),
+                rank=jax.process_index(),
+            )
+            self._telemetry.numerics = self._numerics
+            if self._health is not None:
+                # NaN provenance surfaces as a health anomaly (PR 3
+                # registry): counted, ringed, bundled — and a halt action
+                # stops the run at the facade boundary with the layer
+                # named
+                self._health.detectors.append(
+                    NumericsProvenanceDetector(
+                        self._numerics, ncfg.provenance_action
                     )
                 )
 
@@ -991,6 +1044,7 @@ class Stoke:
             self._scaler_state,
             self._comm_state,
             sentinels,
+            numerics,
             finite,
         ) = self._engine.apply_step(
             self._variables,
@@ -1013,6 +1067,7 @@ class Stoke:
         self._optimizer_steps += 1
         self._grad_accum_counter = 0
         self._reset_tracking_window()
+        self._observe_numerics(numerics)
         self._observe_health(sentinels)
         self._maybe_log_metrics()
         self._maybe_emit_telemetry()
@@ -1078,6 +1133,7 @@ class Stoke:
             self._comm_state,
             self._rng,
             sentinels,
+            numerics,
             finite,
         ) = self._engine.fused_step(
             self._variables,
@@ -1111,6 +1167,7 @@ class Stoke:
             self._optimizer_steps += 1
             self._grad_accum_counter = 0
             self._reset_tracking_window()
+            self._observe_numerics(numerics)
             self._observe_health(sentinels)
             self._maybe_log_metrics()
             self._maybe_emit_telemetry()
@@ -1299,6 +1356,78 @@ class Stoke:
         for i in range(window):
             h.observe(first + i, rows[i] if rows is not None else None)
 
+    # ------------------------------------------------------------------ #
+    # per-layer numerics (ISSUE 12: module sentinels / provenance / quant)
+    # ------------------------------------------------------------------ #
+
+    def _observe_numerics(self, numerics, window: int = 1) -> None:
+        """Feed the just-completed optimizer step(s)' per-group stats
+        matrices to the numerics monitor (one tiny host transfer — the
+        values were computed inside the step's existing dispatch).  NaN
+        provenance derived here is drained into the health anomaly
+        pipeline by the ``numerics_provenance`` detector at the
+        ``_observe_health`` call that immediately follows."""
+        m = self._numerics
+        if m is None or numerics is None:
+            return
+        rows = np.asarray(jax.device_get(numerics), np.float32)
+        m.observe_window(self._optimizer_steps - window + 1, rows)
+
+    def _sample_wire_error(self) -> None:
+        """Per-group error-feedback residual norms at the logging cadence
+        (ISSUE 12 signal family 3a): one small host fetch, attributed to
+        module groups through the transport's bucket layout.  Skipped
+        when no residual is carried, when the config opts out, or when
+        the sharded residual's shards are not addressable (multi-host —
+        a diagnostic must never wedge the step path)."""
+        m = self._numerics
+        if m is None or not m.cfg.wire_error:
+            return
+        try:
+            from stoke_tpu.telemetry.numerics import (
+                wire_residual_group_norms,
+            )
+
+            m.observe_wire(
+                wire_residual_group_norms(
+                    self._engine.transport,
+                    self._comm_state,
+                    self._variables["params"],
+                    m.groups,
+                )
+            )
+        except Exception as e:
+            # non-addressable sharded shards (multi-host) and any future
+            # attribution defect degrade to "no wire signal" — but say so
+            # ONCE, the bounded-warning discipline: a silently-absent
+            # signal family reads as "nothing to report" when it is
+            # actually broken
+            if not self._wire_error_warned:
+                self._wire_error_warned = True
+                self.warn(
+                    f"per-layer wire-error attribution unavailable "
+                    f"({type(e).__name__}: {e}); numerics wire_err will "
+                    f"be absent this run"
+                )
+
+    @property
+    def numerics(self):
+        """The run's per-layer numerics monitor (None without a
+        ``NumericsConfig``) — per-group stats, NaN provenance history,
+        quantization-error attribution."""
+        return self._numerics
+
+    @property
+    def numerics_summary(self) -> Optional[Dict[str, Any]]:
+        """End-of-run per-layer numerics ranking: groups ordered by
+        gradient-noise (running std/mean of each group's grad rms) and by
+        quantization error, the latest per-group stats, and every
+        non-finite provenance event.  None without a
+        ``NumericsConfig``."""
+        if self._numerics is None:
+            return None
+        return self._numerics.summary()
+
     @property
     def health(self) -> Optional[HealthMonitor]:
         """The run's health monitor (None without a ``HealthConfig``)."""
@@ -1427,6 +1556,10 @@ class Stoke:
             self._optimizer_steps, t.config.log_every_n_steps, window
         ):
             return
+        # per-layer wire-error attribution (ISSUE 12): refresh the
+        # per-group residual norms once per logged window so the record
+        # assembled below carries them
+        self._sample_wire_error()
         scaled = self._precision.scaled
         sent = (
             unpack_sentinels(self._last_sentinels)
@@ -1944,6 +2077,7 @@ class Stoke:
             self._comm_state,
             self._rng,
             sentinels,
+            numerics,
             finite,
         ) = self._engine.window_step(
             self._variables,
@@ -1971,6 +2105,7 @@ class Stoke:
             )
         self._optimizer_steps += 1
         self._reset_tracking_window()
+        self._observe_numerics(numerics)
         self._observe_health(sentinels)
         self._maybe_log_metrics()
         self._maybe_emit_telemetry()
@@ -2131,6 +2266,7 @@ class Stoke:
             self._comm_state,
             self._rng,
             sentinels,
+            numerics,
             skipped,
         ) = self._engine.multi_step(
             self._variables,
@@ -2162,6 +2298,7 @@ class Stoke:
         if self._precision.scaled:
             self._skipped_steps = self._skipped_steps + skipped
         self._optimizer_steps += n
+        self._observe_numerics(numerics, window=n)
         self._observe_health(sentinels, window=n)
         self._maybe_log_metrics(window=n)
         self._maybe_emit_telemetry(window=n)
@@ -2573,7 +2710,7 @@ class Stoke:
             # replica owns a full cache (model-sharded pools are a
             # placement change in PagedKVCache, not an engine change)
             kv_sharding = NamedSharding(self._mesh, P())
-        return ServingEngine(
+        engine = ServingEngine(
             module,
             self.params,
             scfg,
@@ -2581,6 +2718,13 @@ class Stoke:
             compile_cache=self._compile_cache,
             kv_sharding=kv_sharding,
         )
+        if self._numerics is not None and engine.quant_errors_by_group:
+            # per-layer dequant-error attribution (ISSUE 12): the engine
+            # computed it once at quantize time; installing it here is
+            # what surfaces numerics/quant_err_max / quant_err_group in
+            # this run's JSONL records and numerics_summary
+            self._numerics.set_quant_errors(engine.quant_errors_by_group)
+        return engine
 
     # ------------------------------------------------------------------ #
     # save / load (reference stoke.py:1060-1142)
@@ -2963,10 +3107,15 @@ class Stoke:
         self.print_on_devices(f"Model parameters: {n}{suffix}")
 
     def dump_model_parameter_info(self) -> None:
-        """Per-leaf name/shape/dtype dump (reference stoke.py:1226-1240)."""
-        flat = jax.tree_util.tree_flatten_with_path(self._variables["params"])[0]
-        for path, leaf in flat:
-            name = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        """Per-leaf name/shape/dtype dump (reference stoke.py:1226-1240).
+        Names use the SAME rendering as the per-layer numerics surfaces
+        (leaf provenance, quantization-error join keys), so they
+        cross-reference exactly."""
+        from stoke_tpu.telemetry.numerics import leaf_path_names
+
+        params = self._variables["params"]
+        leaves = jax.tree_util.tree_leaves(params)
+        for name, leaf in zip(leaf_path_names(params), leaves):
             self.print_on_devices(
                 f"param {name}: shape={tuple(leaf.shape)} dtype={leaf.dtype}"
             )

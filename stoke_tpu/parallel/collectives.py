@@ -217,6 +217,24 @@ class GradTransport:
         pre, wire = self._wire_bytes(layout.total_padded_elems, stages=2.0)
         return {"prequant": pre, "onwire": wire}
 
+    def bucket_leaf_elems(
+        self, params: Any
+    ) -> List[List[Tuple[int, int]]]:
+        """Per-bucket ``[(leaf_index, n_elems), ...]`` membership of the
+        static flattening plan (buckets hold whole leaves, tree order).
+        The per-layer numerics observatory (ISSUE 12) maps the sharded
+        transport's per-BUCKET error-feedback residual norms back to
+        module groups through exactly this table."""
+        if self.cfg is None:
+            return []
+        leaves = jax.tree_util.tree_leaves(params)
+        sizes = self._leaf_sizes(leaves)
+        layout = self._layout(sizes)
+        return [
+            [(i, sizes[i]) for i in indices]
+            for indices, _, _ in layout.buckets
+        ]
+
     def _wire_bytes(self, elems: int, stages: float) -> Tuple[int, int]:
         """Per-device bytes of ``stages`` ring stages over one padded
         payload — ``(N-1)/N × payload`` each — in fp32 (``pre``) vs the

@@ -71,6 +71,12 @@ class ShardedGradTransport(GradTransport):
     ``params_replicated`` says whether the updated parameters all-gather at
     the apply boundary (tiers none/oss/sddp) or stay sharded (fsdp) — it
     only affects the analytic ``param_gather`` byte accounting.
+
+    Per-layer wire-error attribution (ISSUE 12): the per-bucket residual
+    buffers are exactly the quantization error this schedule is carrying;
+    :meth:`GradTransport.bucket_leaf_elems` exposes the bucket → leaf
+    membership so ``telemetry.numerics.wire_residual_group_norms`` can map
+    each bucket's norm back to the module groups whose gradients ride it.
     """
 
     def __init__(

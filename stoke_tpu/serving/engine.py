@@ -159,6 +159,44 @@ class ServingEngine:
         )
         self.quant_stats = compression_stats(params, self.qparams)
         self.metrics.quant_compression.set(self.quant_stats["compression"])
+        # per-layer dequant-error attribution (ISSUE 12): computed ONCE at
+        # quantize time — which module int8 hurt most bounds the serving
+        # quality story, so it rides the registry (numerics/* gauges), the
+        # engine surface (bench --serve quant_err columns), and
+        # stats()["quant_errors"]
+        self.quant_errors: Dict[str, Dict[str, float]] = {}
+        self.quant_errors_by_group: Dict[str, Dict[str, float]] = {}
+        self.quant_err_layer: Optional[str] = None
+        self.quant_err_max: Optional[float] = None
+        if cfg.quant == "int8":
+            from stoke_tpu.serving.quant import quantization_error
+            from stoke_tpu.telemetry.numerics import (
+                leaf_path_names,
+                max_quant_error,
+                module_groups,
+                quant_error_by_group,
+            )
+
+            self.quant_errors = quantization_error(params, self.qparams)
+            self.quant_errors_by_group = quant_error_by_group(
+                self.quant_errors,
+                module_groups(params),
+                leaf_path_names(params),
+            )
+            self.quant_err_layer, self.quant_err_max = max_quant_error(
+                self.quant_errors_by_group
+            )
+            # gauge publication respects the ISSUE 12 default-OFF
+            # contract: on a SHARED telemetry pipeline the numerics/*
+            # series exist only when a NumericsConfig attached a monitor
+            # (Stoke.serve() installs the table on it, which publishes);
+            # a standalone engine's own registry publishes directly
+            if telemetry is None:
+                reg = self.metrics.registry
+                for group, err in self.quant_errors_by_group.items():
+                    reg.gauge(f"numerics/{group}/quant_err_rel_rms").set(
+                        err["rel_rms"]
+                    )
 
         # --- paged KV pool (pillar 1) ---
         max_blocks_per_seq = -(-cfg.max_seq_len // cfg.kv_block_size)
@@ -493,6 +531,13 @@ class ServingEngine:
             "kv_blocks_used": self.allocator.used_blocks,
             "kv_block_occupancy": self.allocator.occupancy,
             "quant": dict(self.quant_stats),
+            # per-layer dequant-error attribution (ISSUE 12): which module
+            # bounds int8 quality, and by how much
+            "quant_errors_by_group": {
+                g: dict(e) for g, e in self.quant_errors_by_group.items()
+            },
+            "quant_err_layer": self.quant_err_layer,
+            "quant_err_max": self.quant_err_max,
             "kv_cache_bytes": self.cache.nbytes,
             **m.latency_percentiles(),
             "goodput_s": {

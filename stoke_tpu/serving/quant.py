@@ -167,6 +167,55 @@ def dequantize_params(qparams: Any) -> Any:
     )
 
 
+def quantization_error(
+    params: Any, qparams: Any, eps: float = 1e-12
+) -> Dict[str, Dict[str, float]]:
+    """Per-leaf dequantization error of a quantized param tree, computed
+    ONCE at quantize time (ISSUE 12 signal family 3b).
+
+    For every :class:`QuantizedTensor` leaf the int8 round trip is
+    compared against its fp source: ``abs_err_max`` is the worst absolute
+    element error, ``rel_rms`` the rms error relative to the source rms —
+    the scale-free "how much of this layer's signal did int8 eat" number
+    the per-layer quality attribution ranks by.  Keys are ``"a/b/c"``
+    leaf-path strings (``telemetry.numerics.leaf_path_names`` order), so
+    ``telemetry.numerics.quant_error_by_group`` folds them straight into
+    module groups.  Unquantized leaves are omitted.
+    """
+    import jax.tree_util as jtu
+
+    # the join keys MUST be the numerics module's leaf-path rendering —
+    # quant_error_by_group matches them against leaf_path_names(params)
+    # verbatim, so reusing the one implementation is the contract
+    from stoke_tpu.telemetry.numerics import leaf_path_names
+
+    is_q = lambda l: isinstance(l, QuantizedTensor)  # noqa: E731
+    paths = leaf_path_names(params)
+    src = jtu.tree_leaves(params)
+    qleaves = jtu.tree_leaves(qparams, is_leaf=is_q)
+    if len(src) != len(qleaves):
+        raise ValueError(
+            f"quantization_error: params has {len(src)} leaves but "
+            f"qparams has {len(qleaves)} — pass the SAME tree the "
+            f"quantizer consumed"
+        )
+    out: Dict[str, Dict[str, float]] = {}
+    for key, leaf, q in zip(paths, src, qleaves):
+        if not isinstance(q, QuantizedTensor):
+            continue
+        orig = np.asarray(leaf, np.float64)
+        deq = np.asarray(q.dequantize(), np.float64)
+        err = deq - orig
+        rms_src = float(np.sqrt(np.mean(orig ** 2)))
+        out[key] = {
+            "abs_err_max": float(np.max(np.abs(err))),
+            "rel_rms": float(
+                np.sqrt(np.mean(err ** 2)) / (rms_src + eps)
+            ),
+        }
+    return out
+
+
 def param_bytes(tree: Any) -> int:
     """HBM bytes of a (possibly quantized) param tree."""
     total = 0

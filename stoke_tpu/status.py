@@ -44,6 +44,7 @@ from stoke_tpu.configs import (
     FleetConfig,
     FSDPConfig,
     MeshConfig,
+    NumericsConfig,
     OffloadDiskConfig,
     OffloadOptimizerConfig,
     OffloadParamsConfig,
@@ -644,6 +645,68 @@ class StokeStatus:
                 )
             return False
 
+        def _numerics_invalid(s):
+            """Per-layer-numerics legality (ISSUE 12): the per-group view
+            surfaces through the telemetry pipeline (so a TelemetryConfig
+            is required), the provenance action must be a known health
+            action — with ``halt`` banned under fp16 for the same reason
+            the nonfinite detector's is (transient infs are the dynamic
+            scaler's normal operation) — and the config must observe at
+            least one signal family (a fully-disabled observatory would
+            silently record nothing)."""
+            cfg = self._configs.get("NumericsConfig")
+            if cfg is None:
+                return False
+            if "TelemetryConfig" not in self._configs:
+                return (
+                    "NumericsConfig requires a TelemetryConfig — the "
+                    "per-layer numerics surface through the telemetry step "
+                    "events; add one or drop the config"
+                )
+            if cfg.provenance_action not in HEALTH_ACTIONS:
+                return (
+                    f"NumericsConfig.provenance_action "
+                    f"{cfg.provenance_action!r} unknown; valid: "
+                    f"{list(HEALTH_ACTIONS)}"
+                )
+            if (
+                cfg.provenance_action == "halt"
+                and s["precision"] is PrecisionOptions.fp16
+            ):
+                return (
+                    "NumericsConfig(provenance_action='halt') is "
+                    "incompatible with precision='fp16' — the dynamic loss "
+                    "scaler tolerates transient infs by skipping the step; "
+                    "use 'record'/'warn'/'dump', or bf16/full precision"
+                )
+            if cfg.top_k < 1:
+                return (
+                    f"NumericsConfig.top_k must be >= 1, got {cfg.top_k}"
+                )
+            if not (cfg.grad_stats or cfg.wire_error):
+                return (
+                    "NumericsConfig with grad_stats=False and "
+                    "wire_error=False observes nothing — enable at least "
+                    "one signal family or drop the config"
+                )
+            if not cfg.grad_stats and cfg.provenance_action in (
+                "dump", "halt"
+            ):
+                # provenance is derived FROM the grad-stats matrix: with
+                # grad_stats off the detector can never fire, and an
+                # explicit escalation that silently no-ops would fake a
+                # guarded run (the chaos-spec discipline: typo'd intent
+                # is a status error, never a silent no-op)
+                return (
+                    f"NumericsConfig(provenance_action="
+                    f"{cfg.provenance_action!r}) requires grad_stats=True "
+                    f"— NaN provenance is derived from the per-group "
+                    f"stats matrix, so with grad_stats=False it can "
+                    f"never fire; enable grad_stats or drop the "
+                    f"escalated action"
+                )
+            return False
+
         def _resilience_invalid(s):
             """Resilience legality (ISSUE 7): the emergency-save root must
             be writable on EVERY process (sharded emergency saves write
@@ -961,6 +1024,10 @@ class StokeStatus:
                 "FleetConfig is invalid for this combination",
             ),
             (
+                _numerics_invalid,
+                "NumericsConfig is invalid for this combination",
+            ),
+            (
                 _resilience_invalid,
                 "ResilienceConfig is invalid",
             ),
@@ -1207,6 +1274,13 @@ class StokeStatus:
         opt-in; without it no cross-host exchange ever runs and the step
         paths are bit-identical to pre-ISSUE-5)."""
         return self._configs.get("FleetConfig")
+
+    @property
+    def numerics_config(self) -> Optional[NumericsConfig]:
+        """None unless explicitly supplied (the per-layer numerics
+        observatory is opt-in; without it the compiled step programs are
+        bit-identical to pre-ISSUE-12)."""
+        return self._configs.get("NumericsConfig")
 
     @property
     def resilience_config(self) -> Optional[ResilienceConfig]:

@@ -79,6 +79,22 @@ from stoke_tpu.telemetry.fleet import (
     unpack_fleet_vector,
     unregister_sync_registry,
 )
+from stoke_tpu.telemetry.numerics import (
+    GROUP_REPORT_FIELDS,
+    N_NUMERICS_STATS,
+    NUMERICS_STATS,
+    ModuleGroup,
+    NumericsMonitor,
+    NumericsProvenanceDetector,
+    compute_group_stats,
+    leaf_path_names,
+    max_quant_error,
+    module_groups,
+    provenance_of,
+    quant_error_by_group,
+    unpack_group_stats,
+    wire_residual_group_norms,
+)
 from stoke_tpu.telemetry.recorder import FlightRecorder
 from stoke_tpu.telemetry.tracing import (
     TRACE_EVENT_KEYS,
@@ -160,6 +176,21 @@ __all__ = [
     "unregister_sync_registry",
     "observe_sync_wait",
     "timed_sync",
+    # per-layer numerics observatory (ISSUE 12)
+    "NUMERICS_STATS",
+    "N_NUMERICS_STATS",
+    "GROUP_REPORT_FIELDS",
+    "ModuleGroup",
+    "NumericsMonitor",
+    "NumericsProvenanceDetector",
+    "compute_group_stats",
+    "leaf_path_names",
+    "max_quant_error",
+    "module_groups",
+    "provenance_of",
+    "quant_error_by_group",
+    "unpack_group_stats",
+    "wire_residual_group_norms",
     # structured tracing (ISSUE 10)
     "TRACE_EVENT_KEYS",
     "ComposedContext",
@@ -209,6 +240,10 @@ class Telemetry:
         # ResilienceConfig is supplied; None keeps the resilience/* keys
         # out of every step event entirely
         self.resilience = None
+        # per-layer numerics monitor (ISSUE 12) — assigned by the facade
+        # when a NumericsConfig is supplied; None keeps the numerics/*
+        # keys out of every step event entirely
+        self.numerics = None
         # cross-process sync timings (Stoke.barrier / checkpoint
         # sync_global_devices) land in this registry even when no
         # TelemetryConfig drives sinks — the wall-clock breakdown and
@@ -512,6 +547,13 @@ class Telemetry:
         if self.resilience is not None:
             resilience_fields = self.resilience.event_fields()
 
+        # per-layer numerics (ISSUE 12): the latest per-group block +
+        # provenance / quant-error attribution rides every record when a
+        # monitor is attached — pure host reads of already-fetched state
+        numerics_fields: Optional[dict] = None
+        if self.numerics is not None:
+            numerics_fields = self.numerics.event_fields()
+
         hbm = hbm_stats() if self.config.track_hbm else None
         record = build_step_event(
             ts=now,
@@ -553,6 +595,7 @@ class Telemetry:
             # serving fields (ISSUE 9): only a ServingEngine emit passes
             # them — training records stay free of every serve/* key
             serve=serve,
+            numerics=numerics_fields,
             **attr_fields,
         )
         snapshot = self.registry.snapshot()

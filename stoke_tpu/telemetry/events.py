@@ -176,6 +176,22 @@ STEP_EVENT_FIELDS: Dict[str, tuple] = {
     "serve/goodput_prefill_s": (False, "nullable_number"),
     "serve/goodput_decode_s": (False, "nullable_number"),
     "serve/quant_compression": (False, "nullable_number"),
+    # per-layer numerics observatory (ISSUE 12; keys absent without a
+    # NumericsConfig): groups is the fixed group count of the run's param
+    # tree; per_group the nullable {group: {stat: value}} block (grad/
+    # param/update rms, absmax, nonfinite element count, plus wire_err /
+    # quant_err when those signal families observed anything) the offline
+    # numerics_diff.py aligns between runs; provenance_* name the FIRST
+    # module group a non-finite value was attributed to (null while the
+    # run is clean); quant_err_* the serving-weight dequant error of the
+    # worst-quantized module (null without int8-served weights)
+    "numerics/groups": (False, "nullable_number"),
+    "numerics/per_group": (False, "nullable_group_block"),
+    "numerics/provenance_group": (False, "nullable_number"),
+    "numerics/provenance_name": (False, "nullable_string"),
+    "numerics/provenance_field": (False, "nullable_string"),
+    "numerics/quant_err_max": (False, "nullable_number"),
+    "numerics/quant_err_group": (False, "nullable_string"),
     "hbm_bytes_in_use": (False, "nullable_number"),
     "hbm_peak_bytes": (False, "nullable_number"),
     "hbm_bytes_limit": (False, "nullable_number"),
@@ -199,6 +215,12 @@ SERVE_STEP_FIELDS = tuple(
     f for f in STEP_EVENT_FIELDS if f.startswith("serve/")
 )
 
+#: the per-layer-numerics subset (populated via ``build_step_event``'s
+#: ``numerics=`` dict; NumericsMonitor.event_fields must match)
+NUMERICS_STEP_FIELDS = tuple(
+    f for f in STEP_EVENT_FIELDS if f.startswith("numerics/")
+)
+
 
 def _kind_ok(value: Any, kind: str) -> bool:
     if kind == "string":
@@ -216,6 +238,22 @@ def _kind_ok(value: Any, kind: str) -> bool:
             return True
         return isinstance(value, list) and all(
             _kind_ok(v, "number") for v in value
+        )
+    if kind == "nullable_group_block":
+        # {group_name: {stat_name: number-or-null}} — the per-layer
+        # numerics block (ISSUE 12); group/stat sets vary per model, so
+        # only the SHAPE is schema-checked here (the stat names are the
+        # numerics module's wire format, drift-guarded in its own tests)
+        if value is None:
+            return True
+        return isinstance(value, dict) and all(
+            isinstance(k, str)
+            and isinstance(v, dict)
+            and all(
+                isinstance(sk, str) and _kind_ok(sv, "nullable_number")
+                for sk, sv in v.items()
+            )
+            for k, v in value.items()
         )
     raise AssertionError(f"unknown schema kind {kind!r}")
 
@@ -324,6 +362,7 @@ def build_step_event(
     fleet: Optional[Dict[str, Any]] = None,
     resilience: Optional[Dict[str, Any]] = None,
     serve: Optional[Dict[str, Any]] = None,
+    numerics: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Assemble + validate a v1 step event (single construction point so the
     schema cannot drift from the writer)."""
@@ -443,6 +482,41 @@ def build_step_event(
         if unknown:
             raise ValueError(
                 f"unknown serve step-event fields {sorted(unknown)}"
+            )
+    if numerics is not None:
+        # per-layer numerics (ISSUE 12): keys appear only when a
+        # NumericsMonitor is attached; the per_group block and string
+        # provenance fields pass through, numbers round like the rest
+        for key in NUMERICS_STEP_FIELDS:
+            value = numerics.get(key)
+            if key == "numerics/per_group":
+                # round the inner numbers when the block is well-formed;
+                # anything else passes through untouched so the schema
+                # validation below rejects it with a ValueError instead
+                # of this builder crashing mid-comprehension
+                if isinstance(value, dict) and all(
+                    isinstance(stats, dict) for stats in value.values()
+                ):
+                    record[key] = {
+                        g: {s: _round(v, 9) for s, v in stats.items()}
+                        for g, stats in value.items()
+                    }
+                else:
+                    record[key] = value
+            elif key in (
+                "numerics/provenance_name",
+                "numerics/provenance_field",
+                "numerics/quant_err_group",
+            ):
+                record[key] = value
+            elif key in ("numerics/groups", "numerics/provenance_group"):
+                record[key] = None if value is None else int(value)
+            else:
+                record[key] = _round(value, 9)
+        unknown = set(numerics) - set(NUMERICS_STEP_FIELDS)
+        if unknown:
+            raise ValueError(
+                f"unknown numerics step-event fields {sorted(unknown)}"
             )
     validate_step_event(record)
     return record

@@ -53,6 +53,11 @@ SENTINEL_FIELDS = (
     "nonfinite_leaves",   # gradient leaves containing any non-finite value
     "scaler_skip",        # 1.0 when the fp16 scaler skipped this step
     "comm_residual_norm", # error-feedback residual norm (0 without EF)
+    # appended by ISSUE 12 (append-only wire format): flat index of the
+    # FIRST gradient leaf carrying a non-finite value, -1 when all finite
+    # — the NonFiniteDetector maps it to a leaf path so bundles name the
+    # culprit even when only a HealthConfig is on
+    "first_nonfinite_leaf",
 )
 SENTINEL_INDEX = {name: i for i, name in enumerate(SENTINEL_FIELDS)}
 N_SENTINELS = len(SENTINEL_FIELDS)
@@ -107,11 +112,21 @@ def compute_sentinels(loss_val, grads, new_params, old_params, finite,
     update_ratio = update_norm / (param_norm + eps)
     leaves = jax.tree_util.tree_leaves(grads)
     if leaves:
-        nonfinite = sum(
-            jnp.any(~jnp.isfinite(l)).astype(jnp.float32) for l in leaves
+        flags = jnp.stack(
+            [jnp.any(~jnp.isfinite(l)) for l in leaves]
+        )
+        nonfinite = jnp.sum(flags.astype(jnp.float32))
+        # first offending leaf's flat index (argmax of the flag vector is
+        # the first True), -1 when every leaf is finite — NaN provenance
+        # at leaf granularity for one extra O(n_leaves) reduction
+        first_bad = jnp.where(
+            jnp.any(flags),
+            jnp.argmax(flags).astype(jnp.float32),
+            jnp.float32(-1.0),
         )
     else:
         nonfinite = jnp.float32(0.0)
+        first_bad = jnp.float32(-1.0)
     skip = 1.0 - jnp.asarray(finite).astype(jnp.float32)
     residual = None
     if isinstance(comm_state, dict):
@@ -128,7 +143,7 @@ def compute_sentinels(loss_val, grads, new_params, old_params, finite,
     )
     return jnp.stack([
         loss, grad_norm, param_norm, update_ratio,
-        jnp.asarray(nonfinite, jnp.float32), skip, res_norm,
+        jnp.asarray(nonfinite, jnp.float32), skip, res_norm, first_bad,
     ])
 
 
@@ -145,22 +160,29 @@ def unpack_sentinels(vec) -> Dict[str, float]:
 
 @dataclass
 class Anomaly:
-    """One detector firing."""
+    """One detector firing.  ``context`` carries structured provenance
+    (e.g. the first offending leaf path / module group, ISSUE 12) so
+    bundles name the culprit machine-readably, not only in the
+    message."""
 
     detector: str
     step: int
     action: str
     message: str
     value: Optional[float] = None
+    context: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        out = {
             "detector": self.detector,
             "step": self.step,
             "action": self.action,
             "message": self.message,
             "value": self.value,
         }
+        if self.context is not None:
+            out["context"] = dict(self.context)
+        return out
 
 
 class _RunningStats:
@@ -280,12 +302,27 @@ class NonFiniteDetector(Detector):
             return None
         n = sentinels.get("nonfinite_leaves", 0.0)
         if n and n > 0:
-            return self._fire(
+            # leaf-level provenance (ISSUE 12 satellite): the sentinel row
+            # carries the FIRST offending leaf's flat index; the monitor's
+            # leaf-path table (facade-installed) names it, so the anomaly
+            # and its bundle say WHERE even when only HealthConfig is on
+            idx = int(sentinels.get("first_nonfinite_leaf", -1.0))
+            context = None
+            where = ""
+            if idx >= 0:
+                context = {"first_leaf_index": idx}
+                paths = getattr(ctx, "leaf_paths", None)
+                if paths and idx < len(paths):
+                    context["first_leaf_path"] = paths[idx]
+                    where = f" (first offending leaf: {paths[idx]})"
+            anomaly = self._fire(
                 step,
                 f"{int(n)} gradient leaves contain non-finite values at "
-                f"step {step}",
+                f"step {step}{where}",
                 value=n,
             )
+            anomaly.context = context
+            return anomaly
         return None
 
 
@@ -587,6 +624,10 @@ class HealthMonitor:
         self._exception_dumps = 0
         self._warned: Dict[str, int] = {}
         self._steps_completed = False
+        # flat-leaf-index -> path-string table for the param/grad tree
+        # (facade-installed; telemetry.numerics.leaf_path_names) — the
+        # NonFiniteDetector's leaf-level provenance lookup
+        self.leaf_paths: Optional[List[str]] = None
         self.watchdog: Optional[HangWatchdog] = None
         if cfg.watchdog:
             self.watchdog = HangWatchdog(

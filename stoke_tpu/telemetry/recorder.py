@@ -23,6 +23,9 @@ triage without re-running:
         trace.json          the structured-trace span ring as Perfetto-
                             loadable trace-event JSON (when tracing is
                             on — ISSUE 10)
+        numerics.json       latest per-layer numerics view + non-finite
+                            provenance history (when the numerics
+                            observatory is on — ISSUE 12)
         stacks.txt          faulthandler all-thread stacks at dump time
 
 Bundles are cheap (the ring is small) and atomic enough for crash paths:
@@ -93,6 +96,7 @@ class FlightRecorder:
         cost_cards_fn: Optional[Callable[[], Any]] = None,
         fleet_fn: Optional[Callable[[], Optional[Dict[str, Any]]]] = None,
         trace_fn: Optional[Callable[[], Any]] = None,
+        numerics_fn: Optional[Callable[[], Optional[Dict[str, Any]]]] = None,
     ):
         self.bundle_dir = bundle_dir
         self._ring: "deque[dict]" = deque(maxlen=int(ring_size))
@@ -114,6 +118,9 @@ class FlightRecorder:
         # ISSUE 10: what the host was doing at time of death — the span
         # ring as Perfetto-loadable trace.json joins every bundle
         self._trace_fn = trace_fn
+        # ISSUE 12: which LAYER was bad at time of death — the per-group
+        # numerics view + provenance history as numerics.json
+        self._numerics_fn = numerics_fn
         self.dumps: List[str] = []
         self._prev_handlers: Dict[int, Any] = {}
         if install_signal_handlers:
@@ -230,6 +237,13 @@ class FlightRecorder:
                     self._write_json(
                         path, "trace.json", {"traceEvents": events}
                     )
+            except Exception:
+                pass
+        if self._numerics_fn is not None:
+            try:
+                numerics = self._numerics_fn()
+                if numerics is not None:
+                    self._write_json(path, "numerics.json", numerics)
             except Exception:
                 pass
         self._write_stacks(path)

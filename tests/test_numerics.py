@@ -1,0 +1,950 @@
+"""Per-layer numerics observatory tests (ISSUE 12).
+
+Covers: the module-grouping wire format (stability across GPT/ResNet/MoE
+param trees — the drift guard), the recombination identity (per-group
+grad sums rebuild the global grad-norm sentinel exactly), NaN provenance
+attribution end-to-end on the 8-device CPU mesh (anomaly + JSONL +
+flight-recorder numerics.json), leaf-level provenance in the
+NonFiniteDetector with only a HealthConfig, quantization-error
+attribution for serving weights (max-error layer vs a host-side
+recomputation) and the transport residual, default-OFF discipline (HLO
+bit-identity + dispatch-count equality + absent JSONL keys), status
+rules, YAML construction, and the offline numerics_diff tool.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from stoke_tpu import (
+    CommConfig,
+    HealthConfig,
+    NumericsConfig,
+    OSSConfig,
+    SDDPConfig,
+    Stoke,
+    StokeOptimizer,
+    StokeStatus,
+    StokeValidationError,
+    TelemetryConfig,
+)
+from stoke_tpu.telemetry.events import build_step_event, read_step_events
+from stoke_tpu.telemetry.numerics import (
+    NUMERICS_STATS,
+    compute_group_stats,
+    leaf_path_names,
+    max_quant_error,
+    module_groups,
+    provenance_of,
+    quant_error_by_group,
+    unpack_group_stats,
+    wire_residual_group_norms,
+)
+
+pytestmark = pytest.mark.numerics
+
+IN, OUT = 8, 4
+
+
+def _sgd(lr=0.1):
+    return StokeOptimizer(
+        optimizer=optax.sgd, optimizer_kwargs={"learning_rate": lr}
+    )
+
+
+def _two_group_params():
+    return {
+        "lay_a": {"w": np.ones((4, 3), np.float32)},
+        "lay_b": {"w": np.ones((4, 3), np.float32)},
+    }
+
+
+def _sep_model(p, x):
+    """Separable two-group model: d(loss)/d(w_g) depends only on x's
+    slice for group g, so a NaN planted in one slice poisons exactly one
+    group's gradients."""
+    return (
+        (p["lay_a"]["w"] * x[:, :4, None]).sum()
+        + (p["lay_b"]["w"] * x[:, 4:, None]).sum()
+    )
+
+
+def _make(tmp_path, tag, *, numerics=True, health=True, log_every=1,
+          numerics_cfg=None, **stoke_kwargs):
+    tdir = str(tmp_path / tag)
+    configs = [
+        TelemetryConfig(
+            output_dir=tdir, log_every_n_steps=log_every,
+            prometheus=False, tensorboard=False,
+            sample_device_time=False, track_hbm=False,
+        )
+    ]
+    if health:
+        configs.append(
+            HealthConfig(
+                dump_signals=False,
+                bundle_dir=os.path.join(tdir, "pm"),
+            )
+        )
+    if numerics:
+        configs.append(numerics_cfg or NumericsConfig())
+    s = Stoke(
+        model=stoke_kwargs.pop("model", _sep_model),
+        optimizer=_sgd(stoke_kwargs.pop("lr", 0.0)),
+        loss=stoke_kwargs.pop("loss", lambda o: o),
+        params=stoke_kwargs.pop("params", _two_group_params()),
+        batch_size_per_device=stoke_kwargs.pop("batch_size_per_device", 8),
+        configs=configs + stoke_kwargs.pop("extra_configs", []),
+        verbose=False,
+        **stoke_kwargs,
+    )
+    return s, tdir
+
+
+# --------------------------------------------------------------------------- #
+# module grouping: the wire format
+# --------------------------------------------------------------------------- #
+
+
+def test_module_groups_partition_and_order():
+    params = {
+        "embed": {"w": np.zeros((4, 2), np.float32)},
+        "block": {
+            "attn": {"w": np.zeros((2, 2), np.float32),
+                     "b": np.zeros((2,), np.float32)},
+            "mlp": {"w": np.zeros((2, 2), np.float32)},
+        },
+        "head": np.zeros((2, 3), np.float32),
+    }
+    groups = module_groups(params)
+    assert [g.name for g in groups] == ["block", "embed", "head"]
+    # the leaf indices partition the flattened tree exactly once
+    all_idx = sorted(i for g in groups for i in g.leaf_indices)
+    assert all_idx == list(range(len(jax.tree_util.tree_leaves(params))))
+    # element counts match the leaves
+    total = sum(g.n_elems for g in groups)
+    assert total == sum(
+        l.size for l in jax.tree_util.tree_leaves(params)
+    )
+    # leaf-path names align with flatten order
+    paths = leaf_path_names(params)
+    assert paths[groups[1].leaf_indices[0]] == "embed/w"
+
+
+def test_module_groups_bare_leaf_tree():
+    groups = module_groups(np.zeros((3, 3), np.float32))
+    assert [g.name for g in groups] == ["params"]
+    assert groups[0].n_elems == 9
+
+
+def test_module_groups_stable_across_param_trees():
+    """Wire-format drift guard (PR-5 style): the group names of the real
+    model trees are pinned — a refactor that silently regroups leaves
+    (changing every per-layer dashboard/JSONL series) must fail a test,
+    not a 3am bisection."""
+    from stoke_tpu.models import BasicNN
+    from stoke_tpu.models.gpt import GPT
+    from stoke_tpu.utils import init_module
+
+    rng = jax.random.PRNGKey(0)
+
+    gpt = GPT(vocab_size=64, size_name="tiny", max_len=16)
+    gvars = init_module(gpt, rng, np.zeros((1, 8), np.int32), train=False)
+    gnames = [g.name for g in module_groups(gvars["params"])]
+    # dict pytrees flatten in sorted-key order — that ordering IS the
+    # group-index wire format this guard pins (GPT ties the LM head to
+    # tok_emb, so there is no separate lm_head group)
+    assert gnames == [
+        "layer_0", "layer_1", "ln_final", "pos_emb", "tok_emb",
+    ]
+
+    moe = GPT(vocab_size=64, size_name="tiny", max_len=16,
+              moe_num_experts=2)
+    mvars = init_module(moe, rng, np.zeros((1, 8), np.int32), train=False)
+    mnames = [g.name for g in module_groups(mvars["params"])]
+    # the MoE tree groups IDENTICALLY to the dense tree — per-layer
+    # attribution survives the expert refactor
+    assert mnames == gnames
+
+    nn = BasicNN()
+    nvars = init_module(
+        nn, rng, np.zeros((1, 32, 32, 3), np.float32), train=False
+    )
+    nnames = [g.name for g in module_groups(nvars["params"])]
+    assert nnames == [
+        "Conv_0", "Conv_1", "Dense_0", "Dense_1", "Dense_2",
+    ]
+
+
+@pytest.mark.slow
+def test_module_groups_stable_resnet():
+    """The ResNet leg of the drift guard (slow: 23M-param init)."""
+    from stoke_tpu.models import ResNet50
+    from stoke_tpu.utils import init_module
+
+    rn = ResNet50(num_classes=2, cifar_stem=True)
+    rvars = init_module(
+        rn, jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32),
+        train=False,
+    )
+    rnames = [g.name for g in module_groups(rvars["params"])]
+    # sorted-key flatten order: blocks first, then the stem/head modules
+    assert rnames[0] == "BottleneckBlock_0"
+    assert rnames[-3:] == ["Dense_0", "conv_init", "norm_init"]
+    assert sum(n.startswith("BottleneckBlock") for n in rnames) == 16
+    # determinism: a second grouping of the same tree is identical
+    assert rnames == [g.name for g in module_groups(rvars["params"])]
+
+
+def test_compute_group_stats_matches_host_math():
+    rng = np.random.default_rng(0)
+    grads = {
+        "a": {"w": rng.normal(size=(4, 3)).astype(np.float32)},
+        "b": {"w": rng.normal(size=(5,)).astype(np.float32)},
+    }
+    old = jax.tree_util.tree_map(
+        lambda l: rng.normal(size=l.shape).astype(np.float32), grads
+    )
+    new = jax.tree_util.tree_map(lambda l: l + 0.25, old)
+    m = np.asarray(compute_group_stats(grads, new, old))
+    groups = module_groups(grads)
+    assert m.shape == (2, len(NUMERICS_STATS))
+    per = unpack_group_stats(m, groups)
+    a = grads["a"]["w"]
+    np.testing.assert_allclose(
+        per["a"]["grad_rms"], np.sqrt((a.astype(np.float64) ** 2).mean()),
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        per["a"]["grad_absmax"], np.abs(a).max(), rtol=1e-6
+    )
+    np.testing.assert_allclose(per["b"]["update_rms"], 0.25, rtol=1e-5)
+    assert per["a"]["nonfinite"] == 0.0
+    assert provenance_of(m, groups) is None
+
+
+def test_provenance_of_field_precedence():
+    groups = module_groups(
+        {"a": np.zeros((2,), np.float32), "b": np.zeros((2,), np.float32)}
+    )
+    m = np.zeros((2, len(NUMERICS_STATS)))
+    # group 1: nonfinite grad elements -> "grad", first offender is b
+    m[1, 2] = 3.0
+    prov = provenance_of(m, groups)
+    assert (prov["group"], prov["name"], prov["field"]) == (1, "b", "grad")
+    # a nonfinite PARAM sum in group 0 now outranks it (first group wins)
+    m[0, 3] = np.nan
+    prov = provenance_of(m, groups)
+    assert (prov["group"], prov["field"]) == (0, "param")
+
+
+# --------------------------------------------------------------------------- #
+# recombination: per-group sums rebuild the global sentinel
+# --------------------------------------------------------------------------- #
+
+
+def test_group_grad_rms_recombines_to_grad_norm_sentinel(tmp_path):
+    """Acceptance: sqrt(sum_g grad_sumsq_g) == the sentinel grad norm
+    within fp32 tolerance — pins the grouping against silently dropped
+    leaves (a leaf missing from every group would shrink the recombined
+    norm, never the sentinel)."""
+    rng = np.random.default_rng(1)
+    s, tdir = _make(
+        tmp_path, "recombine",
+        model=lambda p, x: x @ p["blk_a"]["w"] @ p["blk_b"]["w"],
+        loss=lambda o, y: ((o - y) ** 2).mean(),
+        params={
+            "blk_a": {"w": rng.normal(size=(IN, IN)).astype(np.float32)},
+            "blk_b": {"w": rng.normal(size=(IN, OUT)).astype(np.float32)},
+        },
+        batch_size_per_device=16,
+        lr=0.05,
+    )
+    x = rng.normal(size=(16, IN)).astype(np.float32)
+    y = np.zeros((16, OUT), np.float32)
+    for _ in range(3):
+        s.train_step(x, (y,))
+    s.close_telemetry()
+    from stoke_tpu.telemetry.health import SENTINEL_INDEX
+
+    sent_norm = float(s._last_sentinels[SENTINEL_INDEX["grad_norm"]])
+    per = s.numerics.last_per_group
+    elems = {g.name: g.n_elems for g in s.numerics.groups}
+    recombined = np.sqrt(
+        sum(per[g]["grad_rms"] ** 2 * elems[g] for g in per)
+    )
+    np.testing.assert_allclose(recombined, sent_norm, rtol=1e-5)
+    # and every param leaf is covered by some group
+    assert sum(elems.values()) == sum(
+        l.size for l in jax.tree_util.tree_leaves(s.params)
+    )
+
+
+# --------------------------------------------------------------------------- #
+# provenance acceptance: NaN at layer k -> group k, everywhere
+# --------------------------------------------------------------------------- #
+
+
+def test_nan_provenance_attributed_on_mesh(tmp_path, devices):
+    """ISSUE 12 acceptance: a NaN injected into layer lay_b's gradients
+    on the 8-device CPU mesh is attributed to group index 1 (name+index)
+    in the health anomaly, the JSONL block, and the flight-recorder
+    bundle's numerics.json."""
+    s, tdir = _make(
+        tmp_path, "prov", distributed="dp",
+        numerics_cfg=NumericsConfig(provenance_action="dump"),
+    )
+    x = np.ones((8, 8), np.float32)
+    s.train_step(x, ())
+    bad = x.copy()
+    bad[:, 5] = np.nan  # only lay_b's grad slice
+    s.train_step(bad, ())
+    s.close_telemetry()
+
+    rec = read_step_events(os.path.join(tdir, "steps.jsonl"))[-1]
+    assert rec["numerics/provenance_group"] == 1
+    assert rec["numerics/provenance_name"] == "lay_b"
+    assert rec["numerics/provenance_field"] == "grad"
+    assert rec["numerics/per_group"]["lay_b"]["nonfinite"] > 0
+    assert rec["numerics/per_group"]["lay_a"]["nonfinite"] == 0
+
+    anomalies = {a.detector: a for a in s.health.anomalies}
+    prov = anomalies["numerics_provenance"]
+    assert prov.context["group"] == 1
+    assert prov.context["name"] == "lay_b"
+    assert "lay_b" in prov.message
+
+    # the dump action wrote a bundle whose numerics.json names the layer
+    bundles = [d for d in s.health.recorder.dumps if os.path.isdir(d)]
+    assert bundles
+    nj = json.load(open(os.path.join(bundles[-1], "numerics.json")))
+    assert nj["provenance"]["group"] == 1
+    assert nj["provenance"]["name"] == "lay_b"
+    # summary records the event too
+    summary = s.numerics_summary
+    assert summary["provenance_events"][-1]["name"] == "lay_b"
+    assert summary["provenance_total"] == 1
+
+
+def test_nan_provenance_step_attribution_in_multi_step(tmp_path):
+    """train_steps covers n optimizer steps in one dispatch; a NaN in the
+    SECOND step's batch must be attributed to that step, not the
+    segment boundary."""
+    s, tdir = _make(tmp_path, "multi")
+    xs = np.ones((3, 8, 8), np.float32)
+    xs[1, :, 5] = np.nan  # step 2 of the segment
+    s.train_steps(xs, ())
+    s.close_telemetry()
+    events = s.numerics.summary()["provenance_events"]
+    # the grad NaN is attributed to step 2 (mid-segment), not the
+    # boundary; the update then poisons lay_b's PARAMS (0.0 * nan is
+    # nan), so step 3 reports a param-field event for the same group —
+    # both with the right step stamp
+    assert [(e["step"], e["field"]) for e in events] == [
+        (2, "grad"), (3, "param"),
+    ]
+    assert all(e["name"] == "lay_b" for e in events)
+
+
+def test_nonfinite_detector_names_leaf_path_without_numerics(tmp_path):
+    """Satellite: with ONLY a HealthConfig the nonfinite anomaly still
+    names the first offending leaf (sentinel-carried index + the
+    facade-installed path table)."""
+    s, tdir = _make(tmp_path, "leafpath", numerics=False)
+    assert s.numerics is None
+    x = np.ones((8, 8), np.float32)
+    s.train_step(x, ())
+    bad = x.copy()
+    bad[:, 6] = np.inf
+    s.train_step(bad, ())
+    s.close_telemetry()
+    nf = [a for a in s.health.anomalies if a.detector == "nonfinite_grads"]
+    assert nf, "nonfinite detector did not fire"
+    assert nf[0].context["first_leaf_path"] == "lay_b/w"
+    assert "lay_b/w" in nf[0].message
+
+
+# --------------------------------------------------------------------------- #
+# quantization-error attribution
+# --------------------------------------------------------------------------- #
+
+
+def test_serving_quant_error_max_layer_matches_host_recompute():
+    """Acceptance: the serving engine reports a per-layer dequant error
+    for every quantized module, and its max-error layer matches an
+    independent host-side recomputation from the stored int8 tensors."""
+    from stoke_tpu.configs import ServeConfig
+    from stoke_tpu.models.gpt import GPT
+    from stoke_tpu.serving import ServingEngine
+    from stoke_tpu.serving.quant import QuantizedTensor
+    from stoke_tpu.utils import init_module
+
+    model = GPT(vocab_size=101, size_name="tiny", max_len=32,
+                dropout_rate=0.0)
+    variables = init_module(
+        model, jax.random.PRNGKey(0), np.zeros((1, 8), np.int32),
+        train=False,
+    )
+    eng = ServingEngine(
+        model, variables["params"],
+        ServeConfig(max_seqs=1, kv_block_size=8, max_seq_len=16,
+                    max_new_tokens=2, prefill_pad_multiple=8,
+                    quant="int8", quant_min_size=256),
+    )
+    by_group = eng.quant_errors_by_group
+    assert by_group, "no quantized module reported an error"
+    # every quantized leaf is attributed
+    assert sum(e["leaves"] for e in by_group.values()) == sum(
+        1
+        for l in jax.tree_util.tree_leaves(
+            eng.qparams, is_leaf=lambda l: isinstance(l, QuantizedTensor)
+        )
+        if isinstance(l, QuantizedTensor)
+    )
+    # host-side recomputation: walk params vs qparams directly
+    paths = leaf_path_names(variables["params"])
+    src = jax.tree_util.tree_leaves(variables["params"])
+    qs = jax.tree_util.tree_leaves(
+        eng.qparams, is_leaf=lambda l: isinstance(l, QuantizedTensor)
+    )
+    recomputed = {}
+    for path, orig, q in zip(paths, src, qs):
+        if not isinstance(q, QuantizedTensor):
+            continue
+        err = np.asarray(q.dequantize(), np.float64) - np.asarray(
+            orig, np.float64
+        )
+        rel = np.sqrt((err ** 2).mean()) / (
+            np.sqrt((np.asarray(orig, np.float64) ** 2).mean()) + 1e-12
+        )
+        group = path.split("/", 1)[0]
+        recomputed[group] = max(recomputed.get(group, 0.0), rel)
+    expect_layer = max(recomputed, key=recomputed.get)
+    assert eng.quant_err_layer == expect_layer
+    np.testing.assert_allclose(
+        eng.quant_err_max, recomputed[expect_layer], rtol=1e-6
+    )
+    # summary + registry surface it
+    assert eng.summary()["quant_err_layer"] == expect_layer
+    g = eng.metrics.registry.get(
+        f"numerics/{expect_layer}/quant_err_rel_rms"
+    )
+    assert g is not None and g.value > 0
+
+
+def test_serve_installs_quant_errors_on_numerics_monitor(tmp_path):
+    """Stoke.serve() with int8 weights feeds the engine's per-group
+    dequant errors into the run's numerics monitor, so the training-side
+    JSONL carries numerics/quant_err_max / quant_err_group and the
+    summary ranks by quant error."""
+    from stoke_tpu.configs import ServeConfig
+    from stoke_tpu.models.gpt import GPT
+    from stoke_tpu.utils import init_module
+
+    model = GPT(vocab_size=101, size_name="tiny", max_len=32,
+                dropout_rate=0.0)
+    variables = init_module(
+        model, jax.random.PRNGKey(0), np.zeros((1, 8), np.int32),
+        train=False,
+    )
+    tdir = str(tmp_path / "serve_nm")
+    s = Stoke(
+        model=model,
+        optimizer=_sgd(),
+        loss=lambda o, y: 0.0,
+        params=variables,
+        batch_size_per_device=1,
+        model_train_kwargs={"train": True},
+        model_eval_kwargs={"train": False},
+        configs=[
+            TelemetryConfig(output_dir=tdir, log_every_n_steps=1,
+                            prometheus=False, tensorboard=False,
+                            sample_device_time=False, track_hbm=False),
+            NumericsConfig(),
+            ServeConfig(max_seqs=1, kv_block_size=8, max_seq_len=16,
+                        max_new_tokens=2, prefill_pad_multiple=8,
+                        quant="int8", quant_min_size=256),
+        ],
+        verbose=False,
+    )
+    eng = s.serve()
+    fields = s.numerics.event_fields()
+    assert fields["numerics/quant_err_group"] == eng.quant_err_layer
+    assert fields["numerics/quant_err_max"] == pytest.approx(
+        eng.quant_err_max
+    )
+    assert s.numerics_summary["top_quant_err"]
+    s.close_telemetry()
+
+
+def test_serve_without_numerics_leaves_registry_clean(tmp_path):
+    """Default-OFF contract: a shared telemetry pipeline WITHOUT a
+    NumericsConfig gains no numerics/* gauge from an int8 serve — the
+    engine computes the attribution (engine surface + bench columns) but
+    only a monitor publishes onto shared registries."""
+    from stoke_tpu.configs import ServeConfig
+    from stoke_tpu.models.gpt import GPT
+    from stoke_tpu.utils import init_module
+
+    model = GPT(vocab_size=101, size_name="tiny", max_len=32,
+                dropout_rate=0.0)
+    variables = init_module(
+        model, jax.random.PRNGKey(0), np.zeros((1, 8), np.int32),
+        train=False,
+    )
+    s = Stoke(
+        model=model,
+        optimizer=_sgd(),
+        loss=lambda o, y: 0.0,
+        params=variables,
+        batch_size_per_device=1,
+        model_train_kwargs={"train": True},
+        model_eval_kwargs={"train": False},
+        configs=[
+            TelemetryConfig(output_dir=str(tmp_path / "t"),
+                            log_every_n_steps=1, prometheus=False,
+                            tensorboard=False, sample_device_time=False,
+                            track_hbm=False),
+            ServeConfig(max_seqs=1, kv_block_size=8, max_seq_len=16,
+                        max_new_tokens=2, prefill_pad_multiple=8,
+                        quant="int8", quant_min_size=256),
+        ],
+        verbose=False,
+    )
+    eng = s.serve()
+    assert eng.quant_err_layer is not None  # engine surface still works
+    assert not any(
+        n.startswith("numerics/") for n in s.telemetry.registry.names()
+    )
+    s.close_telemetry()
+
+
+def test_wire_only_config_emits_per_group_block(tmp_path, devices):
+    """NumericsConfig(grad_stats=False, wire_error=True) is a legal
+    config (status allows it): the compiled programs stay untouched but
+    the JSONL per-group block still carries wire_err so
+    numerics_diff.py --stat wire_err can align such runs."""
+    rng = np.random.default_rng(6)
+    s, tdir = _make(
+        tmp_path, "wire_only", distributed="dp",
+        model=lambda p, x: x @ p["blk_a"]["w"] @ p["blk_b"]["w"],
+        loss=lambda o, y: ((o - y) ** 2).mean(),
+        params={
+            "blk_a": {"w": rng.normal(size=(IN, IN)).astype(np.float32)},
+            "blk_b": {"w": rng.normal(size=(IN, OUT)).astype(np.float32)},
+        },
+        batch_size_per_device=2, lr=0.05, health=False,
+        numerics_cfg=NumericsConfig(grad_stats=False, wire_error=True),
+        extra_configs=[CommConfig(dtype="int8", chunk_elems=8,
+                                  bucket_mb=0.001)],
+    )
+    assert not s._engine.numerics_enabled  # programs untouched
+    x = rng.normal(size=(16, IN)).astype(np.float32)
+    y = np.zeros((16, OUT), np.float32)
+    s.train_step(x, (y,))
+    s.train_step(x, (y,))
+    s.close_telemetry()
+    rec = read_step_events(os.path.join(tdir, "steps.jsonl"))[-1]
+    pg = rec["numerics/per_group"]
+    assert pg is not None and set(pg) == {"blk_a", "blk_b"}
+    assert all(set(stats) == {"wire_err"} for stats in pg.values())
+
+
+def test_quant_error_by_group_folds_paths():
+    params = {
+        "a": {"w": np.zeros((4, 4), np.float32)},
+        "b": {"w": np.zeros((4, 4), np.float32),
+              "v": np.zeros((4, 4), np.float32)},
+    }
+    groups = module_groups(params)
+    paths = leaf_path_names(params)
+    errors = {
+        "a/w": {"rel_rms": 0.1, "abs_err_max": 1.0},
+        "b/w": {"rel_rms": 0.3, "abs_err_max": 2.0},
+        "b/v": {"rel_rms": 0.2, "abs_err_max": 5.0},
+    }
+    by_group = quant_error_by_group(errors, groups, paths)
+    assert by_group["b"] == {
+        "rel_rms": 0.3, "abs_err_max": 5.0, "leaves": 2
+    }
+    name, val = max_quant_error(by_group)
+    assert (name, val) == ("b", 0.3)
+    assert max_quant_error({}) == (None, None)
+
+
+def test_wire_error_replicated_grouping_exact(tmp_path, devices):
+    """Replicated transport: the per-leaf residual pytree folds into
+    per-group norms exactly (sqrt of summed squares)."""
+    rng = np.random.default_rng(3)
+    s, tdir = _make(
+        tmp_path, "wire", distributed="dp",
+        model=lambda p, x: x @ p["blk_a"]["w"] @ p["blk_b"]["w"],
+        loss=lambda o, y: ((o - y) ** 2).mean(),
+        params={
+            "blk_a": {"w": rng.normal(size=(IN, IN)).astype(np.float32)},
+            "blk_b": {"w": rng.normal(size=(IN, OUT)).astype(np.float32)},
+        },
+        batch_size_per_device=2,
+        lr=0.05,
+        extra_configs=[CommConfig(dtype="int8", chunk_elems=8,
+                                  bucket_mb=0.001)],
+    )
+    x = rng.normal(size=(16, IN)).astype(np.float32)
+    y = np.zeros((16, OUT), np.float32)
+    s.train_step(x, (y,))
+    s.train_step(x, (y,))
+    s.close_telemetry()
+    norms = wire_residual_group_norms(
+        s._engine.transport, s._comm_state, s.params, s.numerics.groups
+    )
+    res_leaves = jax.tree_util.tree_leaves(s._comm_state["residual"])
+    paths = leaf_path_names(s.params)
+    expect = {}
+    for path, leaf in zip(paths, res_leaves):
+        g = path.split("/", 1)[0]
+        expect[g] = expect.get(g, 0.0) + float(
+            np.sum(np.asarray(leaf, np.float64) ** 2)
+        )
+    for g in expect:
+        np.testing.assert_allclose(
+            norms[g], np.sqrt(expect[g]), rtol=1e-5
+        )
+    # the JSONL block carried wire_err for every group
+    rec = read_step_events(os.path.join(tdir, "steps.jsonl"))[-1]
+    assert all(
+        "wire_err" in stats
+        for stats in rec["numerics/per_group"].values()
+    )
+
+
+def test_wire_error_sharded_covers_all_groups(tmp_path, devices):
+    """Sharded transport (PR 8): per-bucket residual norms map back onto
+    every module group with non-negative values, and bucket_leaf_elems
+    partitions the leaves."""
+    rng = np.random.default_rng(4)
+    s, tdir = _make(
+        tmp_path, "wire_sharded", distributed="dp", oss=True, sddp=True,
+        model=lambda p, x: x @ p["blk_a"]["w"] @ p["blk_b"]["w"],
+        loss=lambda o, y: ((o - y) ** 2).mean(),
+        params={
+            "blk_a": {"w": rng.normal(size=(IN, IN)).astype(np.float32)},
+            "blk_b": {"w": rng.normal(size=(IN, OUT)).astype(np.float32)},
+        },
+        batch_size_per_device=2,
+        lr=0.05,
+        extra_configs=[
+            CommConfig(dtype="int8", chunk_elems=8, bucket_mb=0.001),
+            OSSConfig(min_shard_size=1), SDDPConfig(min_shard_size=1),
+        ],
+    )
+    from stoke_tpu.parallel.zero import ShardedGradTransport
+
+    assert isinstance(s._engine.transport, ShardedGradTransport)
+    x = rng.normal(size=(16, IN)).astype(np.float32)
+    y = np.zeros((16, OUT), np.float32)
+    s.train_step(x, (y,))
+    s.train_step(x, (y,))
+    s.close_telemetry()
+    members = s._engine.transport.bucket_leaf_elems(s.params)
+    flat_idx = sorted(i for bucket in members for i, _ in bucket)
+    assert flat_idx == list(
+        range(len(jax.tree_util.tree_leaves(s.params)))
+    )
+    norms = wire_residual_group_norms(
+        s._engine.transport, s._comm_state, s.params, s.numerics.groups
+    )
+    assert set(norms) == {"blk_a", "blk_b"}
+    assert all(v >= 0 for v in norms.values())
+    assert sum(norms.values()) > 0  # int8 EF carries a real residual
+
+
+# --------------------------------------------------------------------------- #
+# default-OFF discipline
+# --------------------------------------------------------------------------- #
+
+
+def test_default_off_hlo_bit_identical_and_fields_absent(tmp_path, devices):
+    """No NumericsConfig vs NumericsConfig(grad_stats=False): the fused
+    step program is byte-for-byte identical (the host-side-only config
+    is structurally invisible), and without any config the numerics/*
+    JSONL keys are ABSENT, not null."""
+    rng = np.random.default_rng(5)
+    params = {
+        "blk_a": {"w": rng.normal(size=(IN, IN)).astype(np.float32)},
+        "blk_b": {"w": rng.normal(size=(IN, OUT)).astype(np.float32)},
+    }
+    mk = lambda tag, **kw: _make(  # noqa: E731
+        tmp_path, tag, distributed="dp",
+        model=lambda p, x: x @ p["blk_a"]["w"] @ p["blk_b"]["w"],
+        loss=lambda o, y: ((o - y) ** 2).mean(),
+        params=jax.tree_util.tree_map(np.copy, params),
+        batch_size_per_device=2, lr=0.05, health=False, **kw,
+    )
+    s_off, tdir_off = mk("hlo_off", numerics=False)
+    s_hostonly, _ = mk(
+        "hlo_hostonly",
+        numerics_cfg=NumericsConfig(grad_stats=False, wire_error=True),
+    )
+    x = rng.normal(size=(16, IN)).astype(np.float32)
+    y = np.zeros((16, OUT), np.float32)
+
+    def fused_hlo(s):
+        from stoke_tpu.engine import DeferredOutput, is_deferred
+
+        margs = s._place_batch((x,))
+        sentinel = DeferredOutput(None, -1)
+        flat, treedef = jax.tree_util.tree_flatten(
+            ((sentinel, y), {}), is_leaf=is_deferred
+        )
+        arrays = s._place_batch([l for l in flat if not is_deferred(l)])
+        deferred = tuple(
+            (i, l._path) for i, l in enumerate(flat) if is_deferred(l)
+        )
+        fn = s._engine._build_fused(treedef, deferred, True)
+        return fn.lower(
+            s._variables, s._opt_state, s._grad_buf, s._scaler_state,
+            s._comm_state, s._rng, margs, {}, arrays,
+        ).as_text()
+
+    assert fused_hlo(s_off) == fused_hlo(s_hostonly)
+
+    s_off.train_step(x, (y,))
+    s_off.close_telemetry()
+    s_hostonly.close_telemetry()
+    rec = read_step_events(os.path.join(tdir_off, "steps.jsonl"))[-1]
+    assert not any(k.startswith("numerics/") for k in rec)
+
+
+def test_numerics_adds_zero_dispatches(tmp_path):
+    """The sentinel discipline: the group-stats matrix rides the existing
+    compiled programs — dispatch counts are EQUAL with the config on vs
+    off over the same step sequence (all four step APIs exercised)."""
+    def run(tag, numerics):
+        s, _ = _make(
+            tmp_path, tag, numerics=numerics, health=False,
+            model=lambda p, x: x @ p["lay_a"]["w"],
+            loss=lambda o, y: ((o - y) ** 2).mean(),
+            params={"lay_a": {"w": np.ones((IN, OUT), np.float32)}},
+            batch_size_per_device=8, lr=0.1,
+        )
+        x = np.ones((8, IN), np.float32)
+        y = np.zeros((8, OUT), np.float32)
+        s.train_step(x, (y,))
+        out = s.model(x)
+        l = s.loss(out, y)
+        s.backward(l)
+        s.step()
+        s.train_step_window(x[None], (y[None],))
+        s.train_steps(np.stack([x, x]), (np.stack([y, y]),))
+        n = s.dispatch_count
+        s.close_telemetry()
+        return n
+
+    assert run("disp_on", True) == run("disp_off", False)
+
+
+# --------------------------------------------------------------------------- #
+# status rules / YAML / schema
+# --------------------------------------------------------------------------- #
+
+
+def test_status_requires_telemetry():
+    with pytest.raises(StokeValidationError, match="TelemetryConfig"):
+        StokeStatus(batch_size_per_device=1, configs=[NumericsConfig()])
+
+
+def test_status_rejections(tmp_path):
+    tele = TelemetryConfig(output_dir=str(tmp_path / "t"))
+    with pytest.raises(StokeValidationError, match="provenance_action"):
+        StokeStatus(
+            batch_size_per_device=1,
+            configs=[tele, NumericsConfig(provenance_action="explode")],
+        )
+    with pytest.raises(StokeValidationError, match="fp16"):
+        StokeStatus(
+            batch_size_per_device=1, precision="fp16",
+            configs=[tele, NumericsConfig(provenance_action="halt")],
+        )
+    with pytest.raises(StokeValidationError, match="top_k"):
+        StokeStatus(
+            batch_size_per_device=1,
+            configs=[tele, NumericsConfig(top_k=0)],
+        )
+    with pytest.raises(StokeValidationError, match="observes nothing"):
+        StokeStatus(
+            batch_size_per_device=1,
+            configs=[tele, NumericsConfig(grad_stats=False,
+                                          wire_error=False)],
+        )
+    # an escalated provenance action that can never fire (provenance is
+    # derived from the grad-stats matrix) is a status error, not a
+    # silently-unguarded run
+    with pytest.raises(StokeValidationError, match="grad_stats"):
+        StokeStatus(
+            batch_size_per_device=1,
+            configs=[tele, NumericsConfig(grad_stats=False,
+                                          provenance_action="halt")],
+        )
+    # the wire-only config with the default (warn) action stays legal
+    StokeStatus(
+        batch_size_per_device=1,
+        configs=[tele, NumericsConfig(grad_stats=False)],
+    )
+    # the legal shapes construct
+    StokeStatus(
+        batch_size_per_device=1,
+        configs=[tele, NumericsConfig(provenance_action="halt")],
+    )
+
+
+def test_yaml_builds_numerics(tmp_path):
+    from stoke_tpu.utils.yaml_config import stoke_kwargs_from_config
+
+    kwargs = stoke_kwargs_from_config({
+        "batch_size_per_device": 2,
+        "configs": {
+            "TelemetryConfig": {"output_dir": str(tmp_path / "t")},
+            "NumericsConfig": {"provenance_action": "dump", "top_k": 3},
+        },
+    })
+    cfgs = {type(c).__name__: c for c in kwargs["configs"]}
+    assert cfgs["NumericsConfig"].provenance_action == "dump"
+    assert cfgs["NumericsConfig"].top_k == 3
+
+
+def test_schema_rejects_malformed_group_block():
+    base = dict(
+        ts=1.0, step=1, rank=0, window_steps=1, host_dispatch_s=0.0,
+        loader_wait_s=0.0, samples_total=0.0, compiles_total=0,
+        recompiles=0, compile_time_s=0.0,
+    )
+    rec = build_step_event(
+        **base,
+        numerics={
+            "numerics/groups": 1,
+            "numerics/per_group": {"a": {"grad_rms": 1.0}},
+            "numerics/provenance_group": None,
+            "numerics/provenance_name": None,
+            "numerics/provenance_field": None,
+            "numerics/quant_err_max": None,
+            "numerics/quant_err_group": None,
+        },
+    )
+    assert rec["numerics/per_group"]["a"]["grad_rms"] == 1.0
+    with pytest.raises(ValueError, match="unknown numerics"):
+        build_step_event(**base, numerics={"numerics/bogus": 1})
+    with pytest.raises(ValueError, match="numerics/per_group"):
+        build_step_event(
+            **base,
+            numerics={"numerics/per_group": {"a": "not-a-dict"}},
+        )
+
+
+def test_halt_action_stops_run_naming_layer(tmp_path):
+    from stoke_tpu import HealthHaltError
+
+    s, _ = _make(
+        tmp_path, "halt",
+        numerics_cfg=NumericsConfig(provenance_action="halt"),
+    )
+    x = np.ones((8, 8), np.float32)
+    s.train_step(x, ())
+    bad = x.copy()
+    bad[:, 5] = np.nan
+    with pytest.raises(HealthHaltError, match="numerics_provenance"):
+        s.train_step(bad, ())
+    s.close_telemetry()
+
+
+# --------------------------------------------------------------------------- #
+# offline diff tool
+# --------------------------------------------------------------------------- #
+
+
+def _load_diff_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "numerics_diff",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "numerics_diff.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_stream(path, steps, rms_by_group):
+    with open(path, "w") as f:
+        for step in steps:
+            rec = build_step_event(
+                ts=1000.0 + step, step=step, rank=0, window_steps=1,
+                host_dispatch_s=0.01, loader_wait_s=0.0,
+                samples_total=float(step), compiles_total=1, recompiles=0,
+                compile_time_s=0.1,
+                numerics={
+                    "numerics/groups": len(rms_by_group),
+                    "numerics/per_group": {
+                        g: {"grad_rms": v * step}
+                        for g, v in rms_by_group.items()
+                    },
+                    "numerics/provenance_group": None,
+                    "numerics/provenance_name": None,
+                    "numerics/provenance_field": None,
+                    "numerics/quant_err_max": None,
+                    "numerics/quant_err_group": None,
+                },
+            )
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_numerics_diff_ranks_drifting_group(tmp_path, capsys):
+    mod = _load_diff_module()
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    _write_stream(a, [1, 2, 3], {"lay_a": 1.0, "lay_b": 2.0})
+    # run b: lay_b drifts 50%, lay_a only 1%
+    _write_stream(b, [2, 3, 4], {"lay_a": 1.01, "lay_b": 3.0})
+    rc = mod.main([a, b, "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["aligned_steps"] == 2  # steps 2 and 3
+    assert out["rows"][0]["group"] == "lay_b"
+    assert out["rows"][0]["worst_rel"] == pytest.approx(0.5)
+    assert out["rows"][1]["group"] == "lay_a"
+
+
+def test_numerics_diff_exit_2_when_nothing_aligns(tmp_path, capsys):
+    mod = _load_diff_module()
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    _write_stream(a, [1, 2], {"lay_a": 1.0})
+    _write_stream(b, [5, 6], {"lay_a": 1.0})  # disjoint steps
+    assert mod.main([a, b, "--json"]) == 2
+    capsys.readouterr()
+    # a dir without numerics blocks also refuses (mirrors merge tool)
+    c = str(tmp_path / "c.jsonl")
+    with open(c, "w") as f:
+        f.write(json.dumps(build_step_event(
+            ts=1.0, step=1, rank=0, window_steps=1, host_dispatch_s=0.0,
+            loader_wait_s=0.0, samples_total=0.0, compiles_total=0,
+            recompiles=0, compile_time_s=0.0,
+        )) + "\n")
+    assert mod.main([a, c]) == 2
+
+
+def test_numerics_diff_resolves_run_dirs(tmp_path, capsys):
+    mod = _load_diff_module()
+    for run in ("ra", "rb"):
+        os.makedirs(tmp_path / run)
+        _write_stream(
+            str(tmp_path / run / "steps.jsonl"), [1, 2], {"g": 1.0}
+        )
+    assert mod.main([str(tmp_path / "ra"), str(tmp_path / "rb")]) == 0
+    assert "aligned steps" in capsys.readouterr().out
