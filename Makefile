@@ -54,7 +54,8 @@ test-tracing:    ## structured-tracing tests only (span ring/nesting/Perfetto sc
 test-numerics:   ## per-layer numerics tests only (module groups/provenance/quant attribution/diff tool)
 	$(MESH_ENV) python -m pytest tests/ -x -q -m numerics
 
-serve-smoke:     ## CPU-safe continuous-batching serve smoke (Poisson trace, never touches the tunnel)
+serve-smoke:     ## CPU-safe serve smoke: traced chunked-prefill + top-p request end-to-end, then the Poisson trace arm (never touches the tunnel)
+	$(MESH_ENV) python scripts/telemetry_smoke.py --serve-only
 	$(CPU_ENV) python bench.py --preset tiny --serve
 
 autotune-smoke:  ## CPU-safe autotune sweep smoke (>= 4 subprocess trials, never touches the tunnel)

@@ -105,6 +105,19 @@ def record_backend(rec: dict) -> str:
     return "tpu" if "tpu" in text or "chip" in text else "unknown"
 
 
+#: requested-config keys whose ABSENCE from a ledger record means the
+#: record was captured at the named default (pre-ISSUE-13 serve records
+#: were all reference-kernel greedy Poisson traces) — normalizing makes
+#: the stale-substitution guard symmetric: a default run refuses a
+#: pallas/topp/long-prompt capture exactly as an explicit pallas request
+#: refuses a reference record
+_SERVE_KEY_DEFAULTS = {
+    "serve_decode_kernel": "reference",
+    "serve_sampling": "greedy",
+    "serve_long_prompt": False,
+}
+
+
 def _emit_persisted(metric: str, capture_error: str,
                     requested: dict | None = None) -> int:
     """Emit the last verified on-chip measurement as the official value.
@@ -125,10 +138,13 @@ def _emit_persisted(metric: str, capture_error: str,
         rec = None
     if rec and requested:
         for key, want in requested.items():
-            if want is not None and rec.get(key) != want:
+            have = rec.get(key)
+            if have is None and key in _SERVE_KEY_DEFAULTS:
+                have = _SERVE_KEY_DEFAULTS[key]
+            if want is not None and have != want:
                 capture_error += (
                     f" [persisted record not applicable: measured with "
-                    f"{key}={rec.get(key)!r}, run requested {key}={want!r}]"
+                    f"{key}={have!r}, run requested {key}={want!r}]"
                 )
                 rec = None
                 break
@@ -140,6 +156,11 @@ def _emit_persisted(metric: str, capture_error: str,
             if rec.get("serve")
             else A100_BASELINE_IMGS_PER_SEC
         )
+        # a stale emit must be self-describing (ISSUE 13 satellite): the
+        # capture date of the value being restated rides the row as
+        # stale_since AND in the human-read note, so "9257 imgs/s/chip
+        # (stale since 2026-07-29)" needs no tribal knowledge to decode
+        stale_since = rec.get("date") or "unknown date"
         out = {
             "metric": metric,
             "value": rec["value"],
@@ -149,6 +170,7 @@ def _emit_persisted(metric: str, capture_error: str,
             "vs_baseline": round(rec["value"] / baseline, 4),
             "fresh": False,
             "stale": True,
+            "stale_since": rec.get("date"),
             "backend": record_backend(rec),
             "measured_on": rec.get("date"),
             "measured_by": rec.get("source", "bench.py"),
@@ -166,6 +188,9 @@ def _emit_persisted(metric: str, capture_error: str,
                     k: rec.get(k)
                     for k in (
                         "serve", "serve_quant", "serve_max_seqs",
+                        "serve_decode_kernel", "serve_prefill_chunk",
+                        "serve_sampling", "serve_long_prompt",
+                        "tpot_stall_chunked_s", "tpot_stall_unchunked_s",
                         "ttft_p50_s", "ttft_p99_s", "tpot_p50_s",
                         "tpot_p99_s", "batch_fill_mean",
                         "kv_occupancy_peak", "quant_compression",
@@ -176,8 +201,9 @@ def _emit_persisted(metric: str, capture_error: str,
                 else {}
             ),
             "capture_error": capture_error,
-            "note": "persisted last verified on-chip measurement "
-            "(fresh capture failed; see capture_error and BENCH_NOTES.md)",
+            "note": f"persisted on-chip measurement, stale since "
+            f"{stale_since} (fresh capture failed; see capture_error and "
+            f"BENCH_NOTES.md)",
         }
         print(json.dumps(out))
         return 0
@@ -207,6 +233,8 @@ _REGRESSION_CONFIG_KEYS = (
     "xla_flags", "steps_per_dispatch", "comm_dtype", "comm_shard_tier",
     "health", "attribution", "fleet", "tuned", "resilience", "trace",
     "numerics", "serve", "serve_quant", "serve_max_seqs",
+    "serve_decode_kernel", "serve_prefill_chunk", "serve_sampling",
+    "serve_long_prompt",
 )
 
 
@@ -497,57 +525,123 @@ def _serve_bench(args, tiny: bool) -> int:
     variables = init_module(
         model, jax.random.PRNGKey(0), np.zeros((1, 8), np.int32), train=False
     )
-    cfg = ServeConfig(
-        max_seqs=args.serve_max_seqs,
-        kv_block_size=16,
-        max_seq_len=256,
-        max_new_tokens=32,
-        prefill_pad_multiple=32,
-        quant=args.serve_quant,
-        quant_min_size=256,
-    )
-    eng = ServingEngine(model, variables["params"], cfg)
+    # long-prompt arm (ISSUE 13): chunked prefill is the knob under test,
+    # so the arm defaults it ON at one pad bucket (32) when unset
+    long_arm = bool(args.serve_long_prompt)
+    chunk = args.serve_prefill_chunk or (32 if long_arm else None)
+    sampling = args.serve_sampling != "greedy"
+
+    def build_engine(chunk_tokens):
+        cfg = ServeConfig(
+            max_seqs=args.serve_max_seqs,
+            kv_block_size=16,
+            max_seq_len=256,
+            max_new_tokens=32,
+            prefill_pad_multiple=32,
+            quant=args.serve_quant,
+            quant_min_size=256,
+            decode_kernel=args.serve_decode_kernel,
+            prefill_chunk_tokens=chunk_tokens,
+            sampling=sampling,
+            # the topp arm's knobs: a representative production mix
+            temperature=0.8 if sampling else 0.0,
+            top_p=0.9 if sampling else None,
+        )
+        return ServingEngine(model, variables["params"], cfg), cfg
+
+    eng, cfg = build_engine(chunk)
 
     n = args.serve_requests or (8 if tiny else 48)
     r = np.random.default_rng(0)
-    prompts = [
-        r.integers(1, vocab, size=int(L)).astype(np.int32)
-        for L in r.integers(8, 65, size=n)
-    ]
-    out_lens = r.integers(8, 33, size=n)
-    # Poisson arrivals: exponential inter-arrivals at a rate that keeps
-    # the queue pressured (continuous batching has something to do)
-    arrivals = np.cumsum(r.exponential(0.02 if tiny else 0.05, size=n))
+    if long_arm:
+        # one near-max prompt admitted while short requests decode: the
+        # worst-case TPOT-stall scenario chunked prefill exists to fix
+        long_len = cfg.max_seq_len - 40
+        prompts = [
+            r.integers(1, vocab, size=int(L)).astype(np.int32)
+            for L in r.integers(8, 33, size=max(n - 1, 2))
+        ]
+        out_lens = np.full(len(prompts), 24)
+        arrivals = np.zeros(len(prompts))
+        long_prompt = r.integers(1, vocab, size=long_len).astype(np.int32)
+    else:
+        prompts = [
+            r.integers(1, vocab, size=int(L)).astype(np.int32)
+            for L in r.integers(8, 65, size=n)
+        ]
+        out_lens = r.integers(8, 33, size=n)
+        # Poisson arrivals: exponential inter-arrivals at a rate that
+        # keeps the queue pressured (continuous batching has work to do)
+        arrivals = np.cumsum(r.exponential(0.02 if tiny else 0.05, size=n))
+        long_prompt = None
 
-    def trace_pass():
+    def _token_count(engine, rid):
+        req = engine.scheduler.finished.get(rid)
+        if req is not None:
+            return len(req.tokens)
+        for s in engine.scheduler.slots:
+            if s.request is not None and s.request.rid == rid:
+                return len(s.request.tokens)
+        return 0
+
+    def trace_pass(engine):
+        """One pass over the trace.  In the long-prompt arm the long
+        request admits after the shorts start decoding, and the return
+        carries the worst inter-token gap any short request saw — the
+        TPOT stall the chunked/unchunked comparison reports."""
         fills, occs = [], []
         i = 0
         base = time.perf_counter()
-        tokens0 = eng.metrics.tokens_out.value
-        while i < n or eng.scheduler.has_work:
+        tokens0 = engine.metrics.tokens_out.value
+        watch = {}
+        stall = 0.0
+        long_submitted = not long_arm
+        while i < len(prompts) or engine.scheduler.has_work:
             now = time.perf_counter() - base
-            while i < n and arrivals[i] <= now:
-                eng.submit(prompts[i], int(out_lens[i]))
+            while i < len(prompts) and arrivals[i] <= now:
+                rid = engine.submit(prompts[i], int(out_lens[i]))
+                watch[rid] = (0, time.perf_counter())
                 i += 1
-            if eng.scheduler.has_work:
-                eng.step()
-                fills.append(eng.scheduler.batch_fill)
-                occs.append(eng.allocator.occupancy)
-            elif i < n:
+            if long_arm and not long_submitted and i >= len(prompts):
+                # shorts admitted and decoding: drop the long prompt in
+                engine.step()
+                engine.submit(long_prompt, 8)
+                long_submitted = True
+            if engine.scheduler.has_work:
+                engine.step()
+                t_now = time.perf_counter()
+                for rid, (cnt, ts) in list(watch.items()):
+                    c = _token_count(engine, rid)
+                    if c > cnt:
+                        if cnt > 0:
+                            stall = max(stall, t_now - ts)
+                        watch[rid] = (c, t_now)
+                fills.append(engine.scheduler.batch_fill)
+                occs.append(engine.allocator.occupancy)
+            elif i < len(prompts):
                 time.sleep(min(max(arrivals[i] - now, 0.0), 0.01))
         dt = time.perf_counter() - base
         return {
             "wall_s": dt,
-            "tokens": eng.metrics.tokens_out.value - tokens0,
+            "tokens": engine.metrics.tokens_out.value - tokens0,
             "batch_fill_mean": float(np.mean(fills)) if fills else 0.0,
             "kv_occupancy_peak": float(np.max(occs)) if occs else 0.0,
+            "tpot_stall_s": stall,
         }
 
-    trace_pass()  # warm pass: compiles every prefill bucket + decode
+    trace_pass(eng)  # warm pass: compiles every prefill bucket + decode
     # steady-state latency is the claim: drop the warm pass's compile-
     # dominated TTFT/TPOT samples before the measured pass
     eng.metrics.reset_latency_reservoirs()
-    measured = trace_pass()
+    measured = trace_pass(eng)
+
+    stall_unchunked = None
+    if long_arm:
+        # the comparison leg: same trace, chunking disabled — its stall
+        # column is what chunked prefill is measured against
+        eng_off, _ = build_engine(None)
+        trace_pass(eng_off)  # warm
+        stall_unchunked = trace_pass(eng_off)["tpot_stall_s"]
     tokens_per_s = measured["tokens"] / max(measured["wall_s"], 1e-9)
     pct = eng.metrics.latency_percentiles()
     result = {
@@ -560,6 +654,21 @@ def _serve_bench(args, tiny: bool) -> int:
         "serve": True,
         "serve_quant": args.serve_quant,
         "serve_max_seqs": cfg.max_seqs,
+        # serve fast-path columns (ISSUE 13): decode kernel, chunking,
+        # and sampling mode are distinct configurations for the
+        # regression/substitution guards
+        "serve_decode_kernel": args.serve_decode_kernel,
+        "serve_prefill_chunk": chunk,
+        "serve_sampling": args.serve_sampling,
+        "serve_long_prompt": True if long_arm else None,
+        **(
+            {
+                "tpot_stall_chunked_s": round(measured["tpot_stall_s"], 6),
+                "tpot_stall_unchunked_s": round(stall_unchunked, 6),
+            }
+            if long_arm
+            else {}
+        ),
         "requests": n,
         "ttft_p50_s": round(pct["ttft_p50_s"], 6),
         "ttft_p99_s": round(pct["ttft_p99_s"], 6),
@@ -587,6 +696,10 @@ def _serve_bench(args, tiny: bool) -> int:
                 "serve": True,
                 "serve_quant": args.serve_quant,
                 "serve_max_seqs": cfg.max_seqs,
+                "serve_decode_kernel": args.serve_decode_kernel,
+                "serve_prefill_chunk": chunk,
+                "serve_sampling": args.serve_sampling,
+                "serve_long_prompt": True if long_arm else None,
             },
         )
         if regression is not None:
@@ -611,6 +724,22 @@ def _serve_bench(args, tiny: bool) -> int:
                 "serve": True,
                 "serve_quant": args.serve_quant,
                 "serve_max_seqs": cfg.max_seqs,
+                "serve_decode_kernel": args.serve_decode_kernel,
+                "serve_prefill_chunk": chunk,
+                "serve_sampling": args.serve_sampling,
+                "serve_long_prompt": True if long_arm else None,
+                **(
+                    {
+                        "tpot_stall_chunked_s": result[
+                            "tpot_stall_chunked_s"
+                        ],
+                        "tpot_stall_unchunked_s": result[
+                            "tpot_stall_unchunked_s"
+                        ],
+                    }
+                    if long_arm
+                    else {}
+                ),
                 "requests": n,
                 "ttft_p50_s": result["ttft_p50_s"],
                 "ttft_p99_s": result["ttft_p99_s"],
@@ -769,6 +898,37 @@ def main():
     ap.add_argument("--serve-requests", type=int, default=None,
                     help="requests in the synthetic trace (default: 8 "
                     "tiny / 48 full)")
+    ap.add_argument("--serve-decode-kernel", default="reference",
+                    choices=["reference", "pallas"],
+                    help="decode attention kernel of the --serve arm "
+                    "(ISSUE 13): 'reference' is the jnp gathered-block "
+                    "math, 'pallas' the dedicated streaming kernel "
+                    "(HBM→VMEM block walk; interpreter parity mode "
+                    "off-TPU).  A distinct configuration for the "
+                    "stale-substitution and regression guards")
+    ap.add_argument("--serve-prefill-chunk", type=int, default=None,
+                    help="chunked prefill for the --serve arm "
+                    "(ServeConfig.prefill_chunk_tokens; must be a "
+                    "multiple of the arm's pad bucket, 32).  Bounds "
+                    "per-iteration prefill work so a long prompt cannot "
+                    "stall in-flight TPOT.  A distinct configuration for "
+                    "the guards")
+    ap.add_argument("--serve-sampling", default="greedy",
+                    choices=["greedy", "topp"],
+                    help="sampling mode of the --serve arm: 'greedy' is "
+                    "the deterministic argmax baseline, 'topp' serves "
+                    "temperature 0.8 / top-p 0.9 through the sampling-"
+                    "aware programs (per-request seeded key streams).  A "
+                    "distinct configuration for the guards")
+    ap.add_argument("--serve-long-prompt", action="store_true",
+                    help="long-prompt arm (ISSUE 13): one near-max "
+                    "prompt admitted while short requests decode; "
+                    "reports the worst-case TPOT stall the in-flight "
+                    "requests saw WITH chunked prefill "
+                    "(tpot_stall_chunked_s; chunking defaults on at one "
+                    "pad bucket) and WITHOUT (tpot_stall_unchunked_s) — "
+                    "the column pair that shows what chunking buys.  A "
+                    "distinct configuration for the guards")
     ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
     tuned_rec = None
@@ -836,6 +996,26 @@ def main():
                 ),
                 "serve_max_seqs": (
                     args.serve_max_seqs if args.serve else None
+                ),
+                # kernel / sampling / long-prompt wants are ALWAYS
+                # explicit for a serve run (defaults included): absent
+                # ledger keys normalize to the pre-ISSUE-13 defaults
+                # (_SERVE_KEY_DEFAULTS), so a default greedy/reference
+                # run never cites a pallas or topp capture and vice
+                # versa.  prefill_chunk stays a tuning knob of the same
+                # Poisson workload (the --seg rule): explicit = strict,
+                # default = any verified chunking
+                "serve_decode_kernel": (
+                    args.serve_decode_kernel if args.serve else None
+                ),
+                "serve_prefill_chunk": (
+                    args.serve_prefill_chunk if args.serve else None
+                ),
+                "serve_sampling": (
+                    args.serve_sampling if args.serve else None
+                ),
+                "serve_long_prompt": (
+                    bool(args.serve_long_prompt) if args.serve else None
                 ),
                 "tuned": True if args.tuned else None,
                 "fleet": True if args.fleet else None,

@@ -71,6 +71,7 @@ LEDGER_DEFAULT = os.path.join(REPO, "BENCH_RESULTS.json")
 RESNET_METRIC = "cifar10_resnet50_bf16_train_throughput"
 SMOKE_METRIC = "cifar10_basicnn_train_throughput"
 FLASH_METRIC = "flash_attention_fwdbwd_tokens_per_s"
+SERVE_DECODE_METRIC = "serve_paged_decode_tokens_per_s"
 
 
 def _parse_int_list(text: str) -> list:
@@ -102,6 +103,8 @@ def _run_trial(payload: dict) -> dict:
 
     if payload["workload"] == "flash":
         return {**out, **_measure_flash(spec, payload, steps, warmup)}
+    if payload["workload"] == "serve_decode":
+        return {**out, **_measure_serve_decode(spec, payload, steps, warmup)}
 
     import optax
 
@@ -265,6 +268,68 @@ def _measure_flash(spec: TrialSpec, payload: dict, steps: int,
     }
 
 
+def _measure_serve_decode(spec: TrialSpec, payload: dict, steps: int,
+                          warmup: int) -> dict:
+    """Paged-decode kernel trial (ISSUE 13): steady-state latency of
+    ``paged_decode_attention_pallas`` at the spec's block knobs over a
+    synthetic full block pool — the decode-attention dispatch isolated
+    from the rest of the serve loop, so the sweep scores exactly what the
+    knobs move (the HBM→VMEM streaming schedule).  CPU trials run the
+    interpreter on tiny shapes (flow validation only); real sweeps run on
+    the chip under the tunnel lock like every other workload."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from stoke_tpu.ops.flash_attention import paged_decode_attention_pallas
+
+    on_cpu = jax.default_backend() == "cpu"
+    # geometry: a full decode batch over a GPT-small-class cache on chip;
+    # a toy pool under the interpreter
+    B, H, D, BS = (2, 2, 16, 8) if on_cpu else (8, 8, 64, 16)
+    L = int(payload["seq_len"]) if not on_cpu else 64
+    MB = -(-L // BS)
+    NB = B * MB + 1
+    r = np.random.default_rng(0)
+    k_pages = jnp.asarray(r.normal(size=(NB, BS, H, D)).astype(np.float32))
+    v_pages = jnp.asarray(r.normal(size=(NB, BS, H, D)).astype(np.float32))
+    tables = jnp.asarray(
+        np.arange(1, B * MB + 1, dtype=np.int32).reshape(B, MB)
+    )
+    # ragged contexts keep the masked tail honest (the serve batch is
+    # never uniformly full)
+    ctx = jnp.asarray(
+        np.linspace(L // 2, L, B, dtype=np.int32)
+    )
+    q = jnp.asarray(r.normal(size=(B, H, 1, D)).astype(np.float32))
+
+    fn = jax.jit(
+        lambda q_, k_, v_, t_, c_: paged_decode_attention_pallas(
+            q_, k_, v_, t_, c_,
+            pages_per_block=spec.decode_pages_per_block,
+            block_h=spec.decode_block_h,
+            interpret=on_cpu,
+        )
+    )
+    for _ in range(max(warmup, 1)):
+        jax.block_until_ready(fn(q, k_pages, v_pages, tables, ctx))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(q, k_pages, v_pages, tables, ctx)
+    jax.block_until_ready(out)
+    dt = max(time.perf_counter() - t0, 1e-9)
+    return {
+        # one decode dispatch = one fresh token per slot
+        "value": round(B * steps / dt, 1),
+        "unit": "tokens/sec",
+        "mfu": None,
+        "goodput_fraction": None,
+        "bound": None,
+        "wall_s": round(dt, 4),
+    }
+
+
 # --------------------------------------------------------------------------- #
 # driver (jax-free)
 # --------------------------------------------------------------------------- #
@@ -345,7 +410,7 @@ def main() -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="CPU flow validation: BasicNN, tiny knob space, "
                     ">= 4 trials, winner persisted under the smoke metric")
-    ap.add_argument("--workload", choices=["resnet", "flash"],
+    ap.add_argument("--workload", choices=["resnet", "flash", "serve_decode"],
                     default="resnet")
     ap.add_argument("--trials", type=int, default=12,
                     help="total trial budget (baseline included)")
@@ -373,8 +438,15 @@ def main() -> int:
     ap.add_argument("--flash-blocks", default=None,
                     help="flash block-size candidates (workload=flash; "
                     "default 128,256,512, smoke 64,128)")
+    ap.add_argument("--decode-pages", default=None,
+                    help="decode_pages_per_block candidates "
+                    "(workload=serve_decode; default 1,2,4,8, smoke 1,2)")
+    ap.add_argument("--decode-block-hs", default=None,
+                    help="decode_block_h candidates "
+                    "(workload=serve_decode; default 1,2, smoke 1,2)")
     ap.add_argument("--seq-len", type=int, default=None,
-                    help="sequence length for workload=flash")
+                    help="sequence length for workload=flash / cached "
+                    "context length for workload=serve_decode")
     ap.add_argument("--peak-tflops", type=float, default=None,
                     help="MFU denominator for trial attribution "
                     "(default: 197 = v5e bf16 dense; smoke: 1e-3)")
@@ -408,6 +480,7 @@ def main() -> int:
 
     smoke = args.smoke
     flash = args.workload == "flash"
+    serve_decode = args.workload == "serve_decode"
     if flash:
         # smoke runs persist under their own metric: a CPU interpret-mode
         # winner must never masquerade as a real on-chip flash record
@@ -417,6 +490,21 @@ def main() -> int:
         )
         space = {"flash_block_q": blocks, "flash_block_k": blocks}
         base = TrialSpec(flash_block_q=blocks[0], flash_block_k=blocks[0])
+    elif serve_decode:
+        # ISSUE 13 satellite: the serve side's ledgered on-chip number —
+        # sweep the streaming decode kernel's block knobs, same tunnel-
+        # lock discipline and CPU-fallback refusal as the other real
+        # sweeps; smoke winners carry the _smoke suffix so interpreter
+        # tokens/s never masquerade as a chip capture
+        metric = SERVE_DECODE_METRIC + ("_smoke" if smoke else "")
+        pages = _parse_int_list(
+            args.decode_pages or ("1,2" if smoke else "1,2,4,8")
+        )
+        heads = _parse_int_list(args.decode_block_hs or "1,2")
+        space = {"decode_pages_per_block": pages, "decode_block_h": heads}
+        base = TrialSpec(
+            decode_pages_per_block=pages[0], decode_block_h=heads[0]
+        )
     else:
         # baselines carry the workload defaults EXPLICITLY (batch 8/256,
         # seg 2/10 — what the worker would fall back to anyway) so the
@@ -449,7 +537,10 @@ def main() -> int:
             base = TrialSpec(batch=256, steps_per_dispatch=10)
 
     payload_base = {
-        "workload": "smoke" if (smoke and not flash) else args.workload,
+        "workload": (
+            "smoke" if (smoke and not flash and not serve_decode)
+            else args.workload
+        ),
         "steps": args.steps or (2 if smoke else 10),
         "warmup": args.warmup if args.warmup is not None else (1 if smoke else 2),
         "peak_tflops": (
@@ -457,7 +548,8 @@ def main() -> int:
             if args.peak_tflops is not None
             else (1e-3 if smoke else 197.0)
         ),
-        "seq_len": args.seq_len or (128 if smoke else 4096),
+        "seq_len": args.seq_len
+        or (128 if smoke else (2048 if serve_decode else 4096)),
         # dp for EVERY trial of a comm sweep (baseline included), so the
         # comm_dtype knob is measured against a dp baseline instead of
         # confounding the wire format with the dp/no-dp switch

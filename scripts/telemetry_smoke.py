@@ -18,9 +18,12 @@ JSON and carry engine step spans AND a full per-request
 admission->prefill->decode timeline); since ISSUE 12, a per-layer
 numerics window (per-group JSONL block, a NaN injected into a known
 layer attributed to that group's index in record + anomaly, and an
-offline numerics_diff.py alignment of two smoke JSONLs).  Prints the
-step record and a one-line verdict; exit 0 only when everything
-round-trips.
+offline numerics_diff.py alignment of two smoke JSONLs); since ISSUE 13,
+the serve cycle additionally runs one chunked-prefill + top-p request
+(chunk/sampled counters in the JSONL, ``serve/prefill_chunk`` spans
+asserted in the traced timeline; ``--serve-only`` runs just that leg —
+the ``make serve-smoke`` entry).  Prints the step record and a one-line
+verdict; exit 0 only when everything round-trips.
 """
 
 from __future__ import annotations
@@ -31,6 +34,138 @@ import sys
 import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _trace_events(path):
+    with open(path) as f:
+        doc = json.load(f)
+    return [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+
+
+def run_serve_cycle(sv_dir: str) -> dict:
+    """One traced serve cycle end-to-end (ISSUE 9, grown by ISSUE 13):
+    two concurrent greedy requests PLUS one long chunked-prefill + top-p
+    request through the continuous-batching engine (int8 weights), with
+    the serve/* JSONL fields populated (compression >= 3.5x,
+    prefill-chunk and sampled-token counters), every KV block back in the
+    pool after the drain, and the per-request span timelines — including
+    the ``serve/prefill_chunk`` chunk spans — asserted in the exported
+    trace.  Callable standalone (``--serve-only``, the ``make
+    serve-smoke`` leg) or as part of the full smoke."""
+    import numpy as np
+    import optax
+
+    import jax as _jx
+
+    from stoke_tpu import (
+        ServeConfig,
+        Stoke,
+        StokeOptimizer,
+        TelemetryConfig,
+        TraceConfig,
+    )
+    from stoke_tpu.models.gpt import GPT
+    from stoke_tpu.serving import SamplingParams
+    from stoke_tpu.telemetry import read_step_events
+    from stoke_tpu.utils import init_module
+
+    sv_model = GPT(
+        vocab_size=211, size_name="tiny", max_len=128, dropout_rate=0.0
+    )
+    sv_vars = init_module(
+        sv_model, _jx.random.PRNGKey(0), np.zeros((1, 8), np.int32),
+        train=False,
+    )
+    sv = Stoke(
+        model=sv_model,
+        optimizer=StokeOptimizer(
+            optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.1}
+        ),
+        loss=lambda o, y: 0.0,
+        params=sv_vars,
+        batch_size_per_device=1,
+        model_train_kwargs={"train": True},
+        model_eval_kwargs={"train": False},
+        configs=[
+            TelemetryConfig(
+                output_dir=sv_dir, log_every_n_steps=1, prometheus=True,
+                tensorboard=False, sample_device_time=False, track_hbm=False,
+            ),
+            ServeConfig(
+                max_seqs=2, kv_block_size=8, max_seq_len=64,
+                max_new_tokens=4, prefill_pad_multiple=16,
+                quant="int8", quant_min_size=256,
+                # ISSUE 13: chunked prefill + sampling-aware programs
+                # (the two short requests stay greedy — temperature 0)
+                prefill_chunk_tokens=16, sampling=True,
+            ),
+            # traced serve requests (ISSUE 10/13): the per-request
+            # admission -> [chunks] -> prefill -> decode timelines are
+            # parsed below
+            TraceConfig(output_dir=os.path.join(sv_dir, "trace")),
+        ],
+        verbose=False,
+    )
+    sv_eng = sv.serve()
+    sv_r = np.random.default_rng(0)
+    sv_rids = [
+        sv_eng.submit(sv_r.integers(1, 211, size=7).astype(np.int32), 4)
+        for _ in range(2)
+    ]
+    # ISSUE 13: one long prompt (40 > 16 tokens -> 3 chunks) served with
+    # top-p sampling from a pinned seed
+    long_rid = sv_eng.submit(
+        sv_r.integers(1, 211, size=40).astype(np.int32), 4,
+        sampling=SamplingParams(temperature=0.7, top_p=0.9, seed=1),
+    )
+    sv_eng.run()
+    sv.close_telemetry()
+    sv_rec = read_step_events(os.path.join(sv_dir, "steps.jsonl"))[-1]
+    sv_prom = open(os.path.join(sv_dir, "metrics.prom")).read()
+    serve_trace = _trace_events(
+        os.path.join(sv_dir, "trace", "trace.rank0.json")
+    )
+    spans_by_rid = {}
+    for e in serve_trace:
+        rid = (e.get("args") or {}).get("request_id")
+        if rid is not None:
+            spans_by_rid.setdefault(rid, set()).add(e["name"])
+    chunk_spans = [
+        e for e in serve_trace if e["name"] == "serve/prefill_chunk"
+    ]
+    ok = (
+        all(
+            len(sv_eng.scheduler.finished[rid].tokens) == 4
+            for rid in sv_rids + [long_rid]
+        )
+        and sv_rec.get("serve/completed") == 3.0
+        and sv_rec.get("serve/ttft_p50_s") is not None
+        and sv_rec.get("serve/tpot_p50_s") is not None
+        and (sv_rec.get("serve/quant_compression") or 0) >= 3.5
+        and sv_rec.get("serve/kv_block_occupancy") == 0.0
+        and sv_eng.allocator.used_blocks == 0
+        and "stoke_serve_ttft_s" in sv_prom
+        and "stoke_serve_kv_block_occupancy" in sv_prom
+        # ISSUE 13: the chunked + sampled request's wire evidence — the
+        # counters in the JSONL record and the chunk spans in the traced
+        # serve cycle (40 prompt tokens over 16-token chunks = 3)
+        and sv_rec.get("serve/prefill_chunks") == 3.0
+        and sv_rec.get("serve/sampled_tokens") == 4.0
+        and len(chunk_spans) == 3
+        and {"serve/prefill_chunk", "serve/decode"}
+        <= spans_by_rid.get(long_rid, set())
+    )
+    return {
+        "ok": ok,
+        "record": sv_rec,
+        "engine": sv_eng,
+        "prom": sv_prom,
+        "trace_events": serve_trace,
+        "spans_by_rid": spans_by_rid,
+        "chunk_spans": len(chunk_spans),
+        "long_rid": long_rid,
+        "long_tokens": list(sv_eng.scheduler.finished[long_rid].tokens),
+    }
 
 
 def main() -> int:
@@ -231,75 +366,12 @@ def main() -> int:
         and "residual" in zr._comm_state
     )
 
-    # serving stack (ISSUE 9): one serve cycle end-to-end — two CONCURRENT
-    # requests through the continuous-batching engine (prefill + decode
-    # over the paged KV-cache, int8-quantized weights) with the serve/*
-    # JSONL fields populated, the compression ratio >= 3.5x asserted, and
-    # every KV block back in the pool after the drain
-    import jax as _jx
-
-    from stoke_tpu import ServeConfig
-    from stoke_tpu.models.gpt import GPT
-    from stoke_tpu.utils import init_module
-
+    # serving stack (ISSUE 9 + 13): one serve cycle end-to-end
     sv_dir = os.path.join(out_dir, "serve")
-    sv_model = GPT(
-        vocab_size=211, size_name="tiny", max_len=128, dropout_rate=0.0
-    )
-    sv_vars = init_module(
-        sv_model, _jx.random.PRNGKey(0), np.zeros((1, 8), np.int32),
-        train=False,
-    )
-    sv = Stoke(
-        model=sv_model,
-        optimizer=StokeOptimizer(
-            optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.1}
-        ),
-        loss=lambda o, y: 0.0,
-        params=sv_vars,
-        batch_size_per_device=1,
-        model_train_kwargs={"train": True},
-        model_eval_kwargs={"train": False},
-        configs=[
-            TelemetryConfig(
-                output_dir=sv_dir, log_every_n_steps=1, prometheus=True,
-                tensorboard=False, sample_device_time=False, track_hbm=False,
-            ),
-            ServeConfig(
-                max_seqs=2, kv_block_size=8, max_seq_len=64,
-                max_new_tokens=4, prefill_pad_multiple=16,
-                quant="int8", quant_min_size=256,
-            ),
-            # traced serve request (ISSUE 10): the per-request
-            # admission -> prefill -> decode timeline is parsed below
-            TraceConfig(output_dir=os.path.join(sv_dir, "trace")),
-        ],
-        verbose=False,
-    )
-    sv_eng = sv.serve()
-    sv_r = np.random.default_rng(0)
-    sv_rids = [
-        sv_eng.submit(sv_r.integers(1, 211, size=7).astype(np.int32), 4)
-        for _ in range(2)
-    ]
-    sv_eng.run()
-    sv.close_telemetry()
-    sv_rec = read_step_events(os.path.join(sv_dir, "steps.jsonl"))[-1]
-    sv_prom = open(os.path.join(sv_dir, "metrics.prom")).read()
-    serving_ok = (
-        all(
-            len(sv_eng.scheduler.finished[rid].tokens) == 4
-            for rid in sv_rids
-        )
-        and sv_rec.get("serve/completed") == 2.0
-        and sv_rec.get("serve/ttft_p50_s") is not None
-        and sv_rec.get("serve/tpot_p50_s") is not None
-        and (sv_rec.get("serve/quant_compression") or 0) >= 3.5
-        and sv_rec.get("serve/kv_block_occupancy") == 0.0
-        and sv_eng.allocator.used_blocks == 0
-        and "stoke_serve_ttft_s" in sv_prom
-        and "stoke_serve_kv_block_occupancy" in sv_prom
-    )
+    sv_result = run_serve_cycle(sv_dir)
+    serving_ok = sv_result["ok"]
+    sv_rec = sv_result["record"]
+    sv_eng = sv_result["engine"]
 
     # per-layer numerics observatory (ISSUE 12): two runs of a TWO-group
     # model — one clean, one with a NaN injected into the SECOND layer's
@@ -384,22 +456,12 @@ def main() -> int:
     # structured tracing (ISSUE 10): both exported traces must parse as
     # chrome-trace JSON; the train trace must carry engine step spans,
     # the serve trace at least one full request timeline — admission,
-    # prefill, and decode spans sharing one request_id
-    def _trace_events(path):
-        with open(path) as f:
-            doc = json.load(f)
-        return [e for e in doc["traceEvents"] if e.get("ph") == "X"]
-
+    # prefill, and decode spans sharing one request_id (the serve cycle
+    # already parsed its own trace, chunk spans included)
     train_trace = _trace_events(os.path.join(tr_dir, "trace.rank0.json"))
-    serve_trace = _trace_events(
-        os.path.join(sv_dir, "trace", "trace.rank0.json")
-    )
+    serve_trace = sv_result["trace_events"]
     step_span_names = {e["name"] for e in train_trace}
-    spans_by_rid = {}
-    for e in serve_trace:
-        rid = (e.get("args") or {}).get("request_id")
-        if rid is not None:
-            spans_by_rid.setdefault(rid, set()).add(e["name"])
+    spans_by_rid = sv_result["spans_by_rid"]
     tracing_ok = (
         bool(step_span_names & {"stoke/dispatch", "stoke/accum", "stoke/step"})
         and "stoke/place" in step_span_names
@@ -524,6 +586,8 @@ def main() -> int:
         "serve_ttft_p50_s": sv_rec.get("serve/ttft_p50_s"),
         "serve_tpot_p50_s": sv_rec.get("serve/tpot_p50_s"),
         "serve_quant_compression": sv_rec.get("serve/quant_compression"),
+        "serve_prefill_chunks": sv_rec.get("serve/prefill_chunks"),
+        "serve_sampled_tokens": sv_rec.get("serve/sampled_tokens"),
         "numerics": "ok" if numerics_ok else "FAILED",
         "numerics_provenance": nm_rec.get("numerics/provenance_name"),
         "numerics_diff_aligned": diff_report.get("aligned_steps"),
@@ -535,5 +599,29 @@ def main() -> int:
     return 0 if ok else 1
 
 
+def serve_only() -> int:
+    """The ``make serve-smoke`` leg: just the traced serve cycle — one
+    chunked-prefill + top-p request (plus two greedy ones) end-to-end,
+    chunk spans asserted in the exported timeline."""
+    out_dir = os.environ.get(
+        "STOKE_TELEMETRY_SMOKE_DIR",
+        tempfile.mkdtemp(prefix="stoke-serve-smoke-"),
+    )
+    res = run_serve_cycle(os.path.join(out_dir, "serve"))
+    print(json.dumps({
+        "serve_smoke": "ok" if res["ok"] else "FAILED",
+        "output_dir": out_dir,
+        "serve_prefill_chunks": res["record"].get("serve/prefill_chunks"),
+        "serve_sampled_tokens": res["record"].get("serve/sampled_tokens"),
+        "serve_quant_compression": res["record"].get(
+            "serve/quant_compression"
+        ),
+        "chunk_spans": res["chunk_spans"],
+        "long_request_tokens": res["long_tokens"],
+        "trace_requests": sorted(res["spans_by_rid"]),
+    }))
+    return 0 if res["ok"] else 1
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(serve_only() if "--serve-only" in sys.argv[1:] else main())

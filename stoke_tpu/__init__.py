@@ -43,6 +43,7 @@ from stoke_tpu.configs import (
     ShardingOptions,
     StokeOptimizer,
 )
+from stoke_tpu.serving.sampling import SamplingParams
 from stoke_tpu.data import (
     ArrayDataset,
     BucketedDistributedSampler,
@@ -109,6 +110,7 @@ __all__ = [
     "ProfilerConfig",
     "ResilienceConfig",
     "ServeConfig",
+    "SamplingParams",
     "TelemetryConfig",
     "TensorboardConfig",
     "TraceConfig",

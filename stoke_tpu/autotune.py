@@ -12,6 +12,9 @@ already exposes —
 - ``flash_block_q`` / ``flash_block_k``: the Pallas flash-attention
   blocking (``ops/flash_attention.py``),
 - ``comm_dtype``: the gradient-transport wire format (ISSUE 2),
+- ``decode_pages_per_block`` / ``decode_block_h``: the Pallas
+  paged-decode kernel's blocking (ISSUE 13 serve fast path;
+  ``--workload serve_decode``),
 
 — scoring each trial on the attribution vertical's own metrics (per-window
 MFU x goodput fraction, throughput as the fallback) and **pruning the
@@ -50,6 +53,12 @@ KNOB_KIND: Dict[str, str] = {
     "flash_block_q": "memory",
     "flash_block_k": "memory",
     "comm_dtype": "comm",
+    # ISSUE 13 serve fast path: the Pallas paged-decode kernel's block
+    # knobs (KV pages streamed HBM→VMEM per kernel step / heads per grid
+    # cell) — decode attention is HBM-bandwidth-bound, so both are
+    # memory-kind; swept by `scripts/autotune.py --workload serve_decode`
+    "decode_pages_per_block": "memory",
+    "decode_block_h": "memory",
 }
 
 #: bound classification -> knob kinds worth sweeping, in priority order.
@@ -92,6 +101,8 @@ class TrialSpec:
     flash_block_q: Optional[int] = None
     flash_block_k: Optional[int] = None
     comm_dtype: Optional[str] = None
+    decode_pages_per_block: Optional[int] = None
+    decode_block_h: Optional[int] = None
 
     def config_key(self) -> str:
         """Canonical, process-stable identity of this configuration (the

@@ -1223,6 +1223,10 @@ class CompileConfig:
 
 #: prefill attention kernels ServeConfig accepts (validated by status.py)
 SERVE_ATTENTION_KERNELS: Tuple[str, ...] = ("dense", "flash")
+#: decode attention kernels ServeConfig accepts (ISSUE 13): "reference" is
+#: the jnp gathered-block math (XLA-lowered), "pallas" the dedicated
+#: streaming kernel (HBM→VMEM block walk; interpreter parity mode off-TPU)
+SERVE_DECODE_KERNELS: Tuple[str, ...] = ("reference", "pallas")
 #: weight-quantization modes ServeConfig accepts ("none" = serve at the
 #: params' native dtype)
 SERVE_QUANT_MODES: Tuple[str, ...] = ("none", "bf16", "int8")
@@ -1293,6 +1297,42 @@ class ServeConfig:
         attention: prefill kernel — "dense" (causal bias in fp32 softmax)
             or "flash" (the Pallas kernel, ``causal=True``; interpreted
             off-TPU).  Decode always reads the paged cache.
+        decode_kernel: decode attention kernel (ISSUE 13) — "reference"
+            (the jnp gathered-block math, XLA-lowered; bit-identical to
+            the pre-fast-path engine) or "pallas"
+            (``ops.flash_attention.paged_decode_attention_pallas``: the
+            dedicated streaming kernel walking each request's block table
+            HBM→VMEM).  Off-TPU a standalone engine auto-falls-back to
+            the pallas INTERPRETER (the CPU parity mode tests pin against
+            the reference); a real serve config declaring ``device='cpu'``
+            is a status error instead.
+        decode_pages_per_block / decode_block_h: the pallas decode
+            kernel's block knobs (KV pages streamed per kernel step;
+            heads per grid cell).  ``None`` = kernel defaults; both live
+            in the autotune catalog (``decode_pages_per_block`` /
+            ``decode_block_h``) for the ``--workload serve_decode``
+            sweep.
+        prefill_chunk_tokens: chunked prefill (ISSUE 13) — prompts longer
+            than this prefill in fixed chunks of this many tokens,
+            interleaved one chunk per engine iteration with decode steps,
+            so a long prompt cannot stall in-flight requests' TPOT.
+            Must be a multiple of ``prefill_pad_multiple`` (the bucket
+            discipline that bounds compiled-program count; the chunk
+            shape is ONE program).  ``None`` = unchunked (pre-fast-path
+            behavior).
+        sampling: compile the sampling-aware program variants (ISSUE 13):
+            temperature / top-k / top-p drawn in-program from per-request
+            seeded key streams.  Default False — the greedy engine's
+            programs are bit-identical to pre-fast-path, and per-request
+            ``SamplingParams`` are rejected at ``submit()``.
+        temperature / top_k / top_p: default sampling knobs for requests
+            that do not pass their own ``SamplingParams`` (temperature 0
+            = exact greedy argmax; only read when ``sampling=True`` —
+            non-default values without it are a status error, never
+            silently ignored).
+        sampling_seed: base of the deterministic per-request seed default
+            (``sampling_seed + request_id`` when a request sets none), so
+            whole runs replay from the config.
         kv_dtype: KV-cache storage dtype ("float32" for exact parity,
             "bfloat16" to halve cache HBM).
         quant: weight quantization mode ("none" | "bf16" | "int8").
@@ -1317,6 +1357,15 @@ class ServeConfig:
     max_new_tokens: int = 64
     prefill_pad_multiple: int = 64
     attention: str = "dense"
+    decode_kernel: str = "reference"
+    decode_pages_per_block: Optional[int] = None
+    decode_block_h: Optional[int] = None
+    prefill_chunk_tokens: Optional[int] = None
+    sampling: bool = False
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    sampling_seed: int = 0
     kv_dtype: str = "float32"
     quant: str = "none"
     quant_chunk_elems: int = 128

@@ -2692,9 +2692,13 @@ class Stoke:
         if overrides:
             scfg = _dc.replace(scfg, **overrides)
             # replaced fields re-validate through the same status rules a
-            # constructor-supplied config passes
+            # constructor-supplied config passes — with THIS run's device:
+            # the pallas-decode-needs-TPU rule (ISSUE 13) must judge the
+            # override against the facade's real backend, not the
+            # StokeStatus default
             StokeStatus(
                 batch_size_per_device=self._status_obj.batch_size,
+                device=self._status_obj.device,
                 configs=[scfg],
             )
         module = getattr(self._adapter, "module", None)

@@ -18,9 +18,18 @@ from stoke_tpu.ops.chunked_ce import (
     chunked_causal_lm_loss,
     chunked_softmax_cross_entropy,
 )
-from stoke_tpu.ops.flash_attention import flash_attention, make_flash_attention
+from stoke_tpu.ops.flash_attention import (
+    flash_attention,
+    make_flash_attention,
+    paged_decode_attention,
+    paged_decode_attention_pallas,
+    paged_prefill_chunk_attention,
+)
 
 __all__ = [
+    "paged_decode_attention",
+    "paged_decode_attention_pallas",
+    "paged_prefill_chunk_attention",
     "make_ring_attention",
     "make_ulysses_attention",
     "ring_attention",

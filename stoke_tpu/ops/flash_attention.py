@@ -555,6 +555,247 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, context_lens):
     return out.astype(q.dtype)
 
 
+#: default number of KV pages streamed HBM→VMEM per kernel step (ISSUE 13)
+#: — bigger groups amortize DMA issue overhead and enlarge the per-step
+#: matmul; both decode knobs live in the autotune catalog
+#: (``stoke_tpu.autotune.KNOB_KIND``) so ``scripts/autotune.py --workload
+#: serve_decode`` can sweep them on-chip
+DEFAULT_DECODE_PAGES_PER_BLOCK = 8
+#: default heads fetched per kernel step (each head owns its own K/V slice,
+#: so blocking heads widens the DMA transfers rather than sharing them)
+DEFAULT_DECODE_BLOCK_H = 1
+
+
+def _pick_divisor(requested: Optional[int], total: int, default: int) -> int:
+    """Largest divisor of ``total`` that is <= the requested (or default)
+    value — decode block knobs must tile their dimension exactly, and a
+    sweep-supplied candidate that does not divide degrades to the nearest
+    legal size instead of failing the trial."""
+    want = default if requested is None else int(requested)
+    want = max(1, min(want, total))
+    while total % want:
+        want -= 1
+    return want
+
+
+def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_hbm, v_hbm, o_ref,
+                         k_vmem, v_vmem, sem_k, sem_v, *, block_size,
+                         pages_per_block, n_steps, block_h, scale):
+    """Streaming paged-decode attention body (one (batch, head-group) grid
+    cell).  K/V pages stay in HBM (``pltpu.ANY``); each step DMAs
+    ``pages_per_block`` pages of the request's block table into a
+    double-buffered VMEM landing zone (the fetch for step j+1 is issued
+    before step j's compute — pallas_guide.md double-buffering pattern) and
+    folds them into the fp32 online-softmax accumulators.  Inactive table
+    entries point at the reserved scratch block 0, so every DMA is legal;
+    their positions are masked by ``context_lens``, so they contribute
+    nothing (the same dead-block traffic the jnp reference gather pays)."""
+    b = pl.program_id(0)
+    hg = pl.program_id(1)
+    ctx = lens_ref[b, 0]
+    group = pages_per_block * block_size
+
+    def copies(j, slot):
+        # one descriptor per (page, plane): start() issues them, wait()
+        # rebuilds the SAME descriptors so the semaphore byte accounting
+        # matches exactly
+        out = []
+        for p in range(pages_per_block):
+            blk = tables_ref[b, j * pages_per_block + p]
+            for src, dst, sem in (
+                (k_hbm, k_vmem, sem_k), (v_hbm, v_vmem, sem_v)
+            ):
+                out.append(
+                    pltpu.make_async_copy(
+                        src.at[blk, :, pl.ds(hg * block_h, block_h), :],
+                        dst.at[slot, pl.ds(p * block_size, block_size)],
+                        sem.at[slot],
+                    )
+                )
+        return out
+
+    D = q_ref.shape[-1]
+    q = q_ref[0, :, 0, :].astype(jnp.float32) * scale  # [block_h, D]
+    m = [jnp.full((1, 1), _NEG_INF, jnp.float32) for _ in range(block_h)]
+    l = [jnp.zeros((1, 1), jnp.float32) for _ in range(block_h)]
+    acc = [jnp.zeros((1, D), jnp.float32) for _ in range(block_h)]
+
+    for c in copies(0, 0):
+        c.start()
+    for j in range(n_steps):
+        slot = j % 2
+        if j + 1 < n_steps:
+            for c in copies(j + 1, (j + 1) % 2):
+                c.start()
+        for c in copies(j, slot):
+            c.wait()
+        kb = k_vmem[slot].astype(jnp.float32)  # [group, block_h, D]
+        vb = v_vmem[slot].astype(jnp.float32)
+        pos = j * group + jax.lax.broadcasted_iota(
+            jnp.int32, (1, group), 1
+        )
+        valid = pos < ctx  # [1, group]
+        for hh in range(block_h):
+            s = jax.lax.dot_general(
+                q[hh : hh + 1], kb[:, hh, :], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [1, group]
+            s = jnp.where(valid, s, _NEG_INF)
+            m_new = jnp.maximum(m[hh], jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            p = jnp.where(s > _NEG_INF * 0.5, p, 0.0)
+            corr = jnp.exp(m[hh] - m_new)
+            l[hh] = l[hh] * corr + jnp.sum(p, axis=-1, keepdims=True)
+            m[hh] = m_new
+            pv = jax.lax.dot_general(
+                p, vb[:, hh, :], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc[hh] = acc[hh] * corr + pv
+
+    for hh in range(block_h):
+        safe_l = jnp.where(l[hh] > 0, l[hh], 1.0)
+        o_ref[0, hh] = (acc[hh] / safe_l).astype(o_ref.dtype)
+
+
+def paged_decode_attention_pallas(
+    q, k_pages, v_pages, block_tables, context_lens, *,
+    pages_per_block: Optional[int] = None, block_h: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """Pallas paged-decode attention: the dedicated streaming kernel for
+    the serve fast path (ISSUE 13), with
+    :func:`paged_decode_attention` as its pinned reference semantics.
+
+    Decode attention is HBM-bandwidth-bound: the whole job is moving each
+    request's cached K/V past the VPU once.  The jnp reference leaves the
+    memory schedule to XLA's gather lowering; this kernel owns it — grid
+    over ``(batch, heads/block_h)``, the per-request block table in SMEM,
+    the page pool left in HBM (``pltpu.ANY``), and each grid cell walking
+    its table ``pages_per_block`` pages at a time through a
+    double-buffered VMEM landing buffer (``make_async_copy`` issue for
+    step j+1 before step j's compute) into the fp32 online-softmax
+    accumulation.  Same contract as the reference: positions >=
+    ``context_lens[b]`` are masked, unused table entries point at the
+    reserved scratch block 0 (their DMA is legal, their contribution
+    masked), output in the query dtype.
+
+    Args mirror :func:`paged_decode_attention`; the extra knobs:
+
+    Args:
+        pages_per_block: KV pages fetched per kernel step (clamped to the
+            largest divisor of the table width; default
+            ``DEFAULT_DECODE_PAGES_PER_BLOCK``).  The autotune catalog
+            knob ``decode_pages_per_block``.
+        block_h: heads per grid cell (clamped to a divisor of H; default
+            ``DEFAULT_DECODE_BLOCK_H``) — widens each DMA by fetching
+            several heads' slices per page.  Catalog knob
+            ``decode_block_h``.
+        interpret: run through the pallas interpreter (``None`` =
+            auto-select off-TPU, like :func:`flash_attention` — the CPU
+            parity mode the tests pin against the reference).
+    """
+    B, H, one, D = q.shape
+    if one != 1:
+        raise ValueError(
+            f"paged_decode_attention_pallas is single-token decode; got "
+            f"q-length {one}"
+        )
+    if k_pages.shape != v_pages.shape or k_pages.ndim != 4:
+        raise ValueError(
+            f"k_pages/v_pages must be identical [NB, BS, H, D] pools, got "
+            f"{k_pages.shape}/{v_pages.shape}"
+        )
+    if k_pages.shape[2] != H or k_pages.shape[3] != D:
+        raise ValueError(
+            f"page pool heads/dim {k_pages.shape[2:]} do not match the "
+            f"query's {(H, D)}"
+        )
+    if block_tables.ndim != 2 or block_tables.shape[0] != B:
+        raise ValueError(
+            f"block_tables must be [B={B}, MAX_BLOCKS], got "
+            f"{block_tables.shape}"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    BS = int(k_pages.shape[1])
+    MB = int(block_tables.shape[1])
+    ppb = _pick_divisor(pages_per_block, MB, DEFAULT_DECODE_PAGES_PER_BLOCK)
+    bh = _pick_divisor(block_h, H, DEFAULT_DECODE_BLOCK_H)
+    n_steps = MB // ppb
+    kernel = functools.partial(
+        _paged_decode_kernel,
+        block_size=BS, pages_per_block=ppb, n_steps=n_steps, block_h=bh,
+        scale=1.0 / (D**0.5),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, H // bh),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # block tables [B, MB]
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # context lens [B, 1]
+            pl.BlockSpec((1, bh, 1, D), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),   # K pool stays in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),   # V pool stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, bh, 1, D), lambda b, h: (b, h, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, 1, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, ppb * BS, bh, D), k_pages.dtype),
+            pltpu.VMEM((2, ppb * BS, bh, D), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(
+        block_tables.astype(jnp.int32),
+        context_lens.reshape(B, 1).astype(jnp.int32),
+        q,
+        k_pages,
+        v_pages,
+    )
+    return out
+
+
+def paged_prefill_chunk_attention(q, k_pages, v_pages, block_tables,
+                                  positions):
+    """Chunked-prefill attention over a paged KV-cache (ISSUE 13).
+
+    A prompt chunk's queries attend over everything already cached for the
+    request — the earlier chunks' K/V (written to the block pool by prior
+    chunk dispatches) plus this chunk's own (written by the hook before
+    attention runs, exactly like decode writes the fresh token first).
+    Causality is positional: query at global position ``p`` attends cache
+    window positions ``<= p``, which covers both the intra-chunk causal
+    mask and the inter-chunk prefix in one predicate.  The generalization
+    of :func:`paged_decode_attention` to q-length C (its C == 1, positions
+    == context_lens - 1 special case) and the reference semantics for a
+    future Pallas chunk kernel.
+
+    Args:
+        q: ``[B, H, C, D]`` chunk queries.
+        k_pages / v_pages: ``[NB, BS, H, D]`` block pool for one layer.
+        block_tables: ``[B, MAX_BLOCKS] int32`` per-request block ids.
+        positions: ``[B, C] int32`` global token positions of the chunk's
+            queries (padding rows past the prompt end may hold clamped
+            positions — their outputs are discarded by the caller).
+
+    Returns ``[B, H, C, D]`` attention outputs in the query dtype.
+    """
+    B, H, C, D = q.shape
+    k = jnp.take(k_pages, block_tables, axis=0).reshape(B, -1, H, D)
+    v = jnp.take(v_pages, block_tables, axis=0).reshape(B, -1, H, D)
+    s = jnp.einsum(
+        "bhqd,bwhd->bhqw", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) / (D**0.5)
+    w_pos = jnp.arange(k.shape[1], dtype=jnp.int32)
+    valid = w_pos[None, None, :] <= positions[:, :, None]  # [B, C, W]
+    s = jnp.where(valid[:, None, :, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqw,bwhd->bhqd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
 def make_flash_attention(
     causal: bool = False, block_q: Optional[int] = None,
     block_k: Optional[int] = None, interpret: Optional[bool] = None,

@@ -8,6 +8,8 @@ The inference vertical behind ``Stoke.serve()``:
   admission, eviction, block refill) over the native request packer;
 - :mod:`~stoke_tpu.serving.quant` — int8/bf16 weight store reusing the
   PR-2 stochastic-rounding quantizer, matmul-side dequant;
+- :mod:`~stoke_tpu.serving.sampling` — temperature / top-k / top-p
+  sampling with per-request seeded key streams (ISSUE 13);
 - :mod:`~stoke_tpu.serving.telemetry` — TTFT/TPOT histograms + p50/p99
   gauges, capacity gauges, queue/prefill/decode goodput buckets;
 - :mod:`~stoke_tpu.serving.engine` — the prefill/decode-split engine
@@ -30,10 +32,18 @@ from stoke_tpu.serving.quant import (
     param_bytes,
     quantize_params,
 )
+from stoke_tpu.serving.sampling import (
+    SamplingParams,
+    sample_tokens,
+    validate_sampling_params,
+)
 from stoke_tpu.serving.scheduler import Request, Scheduler
 from stoke_tpu.serving.telemetry import ServeMetrics
 
 __all__ = [
+    "SamplingParams",
+    "sample_tokens",
+    "validate_sampling_params",
     "ServingEngine",
     "PagedKVCache",
     "PagedAttentionHook",

@@ -23,11 +23,21 @@ through plain ``jax.jit`` (page buffers donated off-CPU, so cache updates
 are in-place in HBM), and read weights through the ISSUE 9 quantized
 store (``serving/quant.py``; dequant fused matmul-side by XLA).
 
-Sampling is greedy argmax — deterministic by design: the
+Sampling defaults to greedy argmax — deterministic by design: the
 continuous-batching acceptance (staggered admission produces token
 streams identical to sequential generation) is only testable under a
 deterministic sampler, and the decode program's fixed batch shape makes
-per-slot results independent of co-batched requests.
+per-slot results independent of co-batched requests.  Since ISSUE 13
+``ServeConfig(sampling=True)`` compiles sampling-aware program variants
+instead (temperature / top-k / top-p drawn in-program from per-request
+seeded key streams — ``serving/sampling.py``); the greedy engine's
+programs stay bit-identical to pre-fast-path.  The same ISSUE adds the
+serve fast path's other two pieces: ``decode_kernel="pallas"`` routes
+decode attention through the streaming Pallas kernel
+(``ops.flash_attention.paged_decode_attention_pallas``), and
+``prefill_chunk_tokens`` bounds per-iteration prefill work so one long
+prompt cannot stall the in-flight decode batch (chunks interleave with
+decode steps; ``serve/prefill_chunk`` spans on the request timeline).
 """
 
 from __future__ import annotations
@@ -51,6 +61,13 @@ from stoke_tpu.serving.quant import (
     compression_stats,
     dequantize_params,
     quantize_params,
+)
+from stoke_tpu.serving.sampling import (
+    SamplingParams,
+    initial_key_data,
+    sample_tokens,
+    split_key_data,
+    validate_sampling_params,
 )
 from stoke_tpu.serving.scheduler import Request, Scheduler
 from stoke_tpu.serving.telemetry import ServeMetrics
@@ -122,6 +139,17 @@ class ServingEngine:
             raise ValueError(
                 f"ServeConfig.max_seq_len={cfg.max_seq_len} exceeds the "
                 f"model's max_len={model.max_len}"
+            )
+        if (
+            cfg.prefill_chunk_tokens is not None
+            and cfg.prefill_chunk_tokens % cfg.prefill_pad_multiple
+        ):
+            raise ValueError(
+                f"prefill_chunk_tokens={cfg.prefill_chunk_tokens} must be "
+                f"a multiple of prefill_pad_multiple="
+                f"{cfg.prefill_pad_multiple} (the bucket discipline that "
+                f"bounds compiled-program count; same rule the status "
+                f"layer enforces)"
             )
         if _round_up(cfg.max_seq_len, cfg.prefill_pad_multiple) > model.max_len:
             raise ValueError(
@@ -225,14 +253,72 @@ class ServingEngine:
             default_max_new_tokens=cfg.max_new_tokens,
             eos_id=cfg.eos_id,
             pad_multiple=cfg.prefill_pad_multiple,
+            prefill_chunk_tokens=cfg.prefill_chunk_tokens,
+            sampling_seed_base=cfg.sampling_seed,
         )
+
+        # --- serve fast path (ISSUE 13): decode kernel + sampling state ---
+        # pallas decode off-TPU auto-falls-back to the interpreter (the
+        # CPU parity mode the tests pin); a REAL serve config declaring a
+        # CPU device is rejected upstream by the status layer instead
+        self._decode_interpret = (
+            jax.default_backend() != "tpu"
+            if cfg.decode_kernel == "pallas"
+            else None
+        )
+        self._sampling = bool(cfg.sampling)
+        # config-level default knobs (requests may override per-submit);
+        # greedy when sampling is off — those engines never consult them
+        self._default_sampling = (
+            SamplingParams(
+                temperature=cfg.temperature,
+                top_k=cfg.top_k,
+                top_p=cfg.top_p,
+            )
+            if self._sampling
+            else SamplingParams()
+        )
+        if self._sampling:
+            validate_sampling_params(self._default_sampling)
+        # per-slot PRNG key state, threaded through the sampling-mode
+        # dispatches like the KV pages (wrapped to TYPED keys in-program,
+        # split once per emitted token, advanced data written back) —
+        # maintained whenever any program consumes it
+        kd = initial_key_data(0)
+        self._key_data = np.zeros(
+            (cfg.max_seqs,) + kd.shape, kd.dtype
+        )
+        # counterfactual-parity hook (tests): when True, every sampling-
+        # mode dispatch's PRE-sampling logits are fetched and recorded
+        # per request id — the bit-match check staggered-vs-sequential
+        # sampling leans on (greedy streams can no longer assert it)
+        self.capture_logits = False
+        self.captured_logits: Dict[int, List[np.ndarray]] = {}
 
         # --- compiled programs (pillar 3) ---
         # donation keeps the page pool in-place in HBM; the CPU backend
         # has no donation (jax warns and copies), so only donate off-CPU
         donate = (1, 2) if jax.default_backend() != "cpu" else ()
-        self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=donate)
-        self._decode_jit = jax.jit(self._decode_fn, donate_argnums=donate)
+        if self._sampling:
+            self._prefill_jit = jax.jit(
+                self._prefill_sampling_fn, donate_argnums=donate
+            )
+            self._decode_jit = jax.jit(
+                self._decode_sampling_fn, donate_argnums=donate
+            )
+        else:
+            # greedy programs are the PRE-ISSUE-13 ones verbatim: with
+            # decode_kernel="reference" their HLO and token streams are
+            # bit-identical to the pre-fast-path engine
+            self._prefill_jit = jax.jit(
+                self._prefill_fn, donate_argnums=donate
+            )
+            self._decode_jit = jax.jit(self._decode_fn, donate_argnums=donate)
+        self._chunk_jit = (
+            jax.jit(self._chunk_fn, donate_argnums=donate)
+            if cfg.prefill_chunk_tokens is not None
+            else None
+        )
 
         self._iterations = 0
         self._last_emit_iter = 0
@@ -252,6 +338,20 @@ class ServingEngine:
             kv_cache=hook,
         )
 
+    def _make_hook(self, k_pages, v_pages, tables, positions, mode, lengths):
+        """The per-trace cache hook with this engine's kernel selection —
+        with the default ``decode_kernel="reference"`` the constructed
+        graph is op-for-op the pre-ISSUE-13 one."""
+        return PagedAttentionHook(
+            k_pages, v_pages, tables, positions,
+            mode=mode, lengths=lengths,
+            attention_impl=self.cfg.attention,
+            decode_impl=self.cfg.decode_kernel,
+            decode_pages_per_block=self.cfg.decode_pages_per_block,
+            decode_block_h=self.cfg.decode_block_h,
+            decode_interpret=self._decode_interpret,
+        )
+
     def _prefill_fn(self, qparams, k_pages, v_pages, tokens, block_row,
                     prompt_len):
         """tokens [1, P] padded prompt; block_row [1, MB]; prompt_len [1].
@@ -259,10 +359,8 @@ class ServingEngine:
         params = dequantize_params(qparams)
         P = tokens.shape[1]
         positions = jnp.arange(P, dtype=jnp.int32)[None, :]
-        hook = PagedAttentionHook(
-            k_pages, v_pages, block_row, positions,
-            mode="prefill", lengths=prompt_len,
-            attention_impl=self.cfg.attention,
+        hook = self._make_hook(
+            k_pages, v_pages, block_row, positions, "prefill", prompt_len
         )
         logits = self._apply(params, tokens, positions, hook, decode=False)
         last = logits[0, prompt_len[0] - 1]
@@ -277,10 +375,9 @@ class ServingEngine:
         """tokens/positions [B]; block_tables [B, MB]; context_lens [B].
         Returns (next tokens [B], updated pages)."""
         params = dequantize_params(qparams)
-        hook = PagedAttentionHook(
-            k_pages, v_pages, block_tables, positions[:, None],
-            mode="decode", lengths=context_lens,
-            attention_impl=self.cfg.attention,
+        hook = self._make_hook(
+            k_pages, v_pages, block_tables, positions[:, None], "decode",
+            context_lens,
         )
         logits = self._apply(
             params, tokens[:, None], positions[:, None], hook, decode=True
@@ -290,6 +387,67 @@ class ServingEngine:
             hook.k_pages,
             hook.v_pages,
         )
+
+    # --- sampling-mode programs (ISSUE 13): same forward, the draw added
+    # in-program on the pre-sampling logits; key state threaded like the
+    # pages.  Compiled INSTEAD of the greedy bodies only when
+    # ``ServeConfig.sampling`` is set, so the default engine's programs
+    # stay bit-identical to pre-fast-path. ---
+
+    def _prefill_sampling_fn(self, qparams, k_pages, v_pages, tokens,
+                             block_row, prompt_len, key_data, temp, top_k,
+                             top_p):
+        """Sampling prefill: returns (token [1], advanced key data,
+        pre-sampling logits row [1, V], updated pages)."""
+        params = dequantize_params(qparams)
+        P = tokens.shape[1]
+        positions = jnp.arange(P, dtype=jnp.int32)[None, :]
+        hook = self._make_hook(
+            k_pages, v_pages, block_row, positions, "prefill", prompt_len
+        )
+        logits = self._apply(params, tokens, positions, hook, decode=False)
+        row = logits[0, prompt_len[0] - 1][None, :]
+        key_out, sub = split_key_data(key_data)
+        tok = sample_tokens(row, sub, temp, top_k, top_p)
+        return tok, key_out, row, hook.k_pages, hook.v_pages
+
+    def _decode_sampling_fn(self, qparams, k_pages, v_pages, tokens,
+                            positions, block_tables, context_lens, key_data,
+                            temps, top_ks, top_ps):
+        """Sampling decode: returns (tokens [B], advanced key data,
+        pre-sampling logits [B, V], updated pages)."""
+        params = dequantize_params(qparams)
+        hook = self._make_hook(
+            k_pages, v_pages, block_tables, positions[:, None], "decode",
+            context_lens,
+        )
+        logits = self._apply(
+            params, tokens[:, None], positions[:, None], hook, decode=True
+        )[:, -1, :]
+        key_out, sub = split_key_data(key_data)
+        tok = sample_tokens(logits, sub, temps, top_ks, top_ps)
+        return tok, key_out, logits, hook.k_pages, hook.v_pages
+
+    def _chunk_fn(self, qparams, k_pages, v_pages, tokens, positions,
+                  block_row, prompt_len, logit_idx, key_data, temp, top_k,
+                  top_p):
+        """ONE chunked-prefill step (ISSUE 13): tokens [1, C] at GLOBAL
+        positions [1, C]; writes the chunk's K/V into the request's
+        blocks and attends over everything cached so far (causal by
+        global position).  Samples from the ``logit_idx`` row — the last
+        prompt token's — which only the FINAL chunk's caller consumes
+        (greedy encodes as temperature 0, so one program serves both
+        modes; the chunk shape is fixed, so the compile-cache ledger
+        registers it once)."""
+        params = dequantize_params(qparams)
+        hook = self._make_hook(
+            k_pages, v_pages, block_row, positions, "chunk", prompt_len
+        )
+        logits = self._apply(params, tokens, positions, hook, decode=False)
+        row = logits[0, logit_idx[0]][None, :]
+        key_out, sub = split_key_data(key_data)
+        tok = sample_tokens(row, sub, temp, top_k, top_p)
+        return tok, key_out, row, hook.k_pages, hook.v_pages
 
     # ------------------------------------------------------------------ #
     # program-signature dispatch (PR-6 AOT ledger registration)
@@ -323,9 +481,35 @@ class ServingEngine:
         prompt: Sequence[int],
         max_new_tokens: Optional[int] = None,
         eos_id: Optional[int] = None,
+        sampling: Optional[SamplingParams] = None,
     ) -> int:
-        """Enqueue one request (mid-flight is the point); returns its id."""
-        rid = self.scheduler.submit(prompt, max_new_tokens, eos_id)
+        """Enqueue one request (mid-flight is the point); returns its id.
+
+        ``sampling`` (ISSUE 13) carries per-request temperature / top-k /
+        top-p / seed — validated here, never mid-decode — and requires
+        ``ServeConfig.sampling=True`` (the sampling-aware programs are a
+        construction-time choice; the default greedy engine's programs
+        are bit-identical to pre-fast-path).  Without it the request uses
+        the config's default knobs; a request without an explicit seed
+        gets the deterministic per-request default
+        ``sampling_seed + rid``, so whole runs replay from the config.
+        """
+        if sampling is not None:
+            if not self._sampling:
+                raise ValueError(
+                    "per-request SamplingParams need ServeConfig."
+                    "sampling=True (the sampling-aware decode programs "
+                    "are compiled at engine construction; docs/serving.md)"
+                )
+            validate_sampling_params(sampling)
+            params = sampling
+        else:
+            params = self._default_sampling
+        # the scheduler resolves the seed beside the rid it assigns
+        # (explicit params.seed wins, else sampling_seed + rid)
+        rid = self.scheduler.submit(
+            prompt, max_new_tokens, eos_id, params=params
+        )
         self.metrics.requests.inc()
         return rid
 
@@ -336,9 +520,121 @@ class ServingEngine:
     # the engine loop
     # ------------------------------------------------------------------ #
 
+    def _sampling_scalar_args(self, params: SamplingParams, slot: int):
+        """The per-request sampling tail of a prefill/chunk dispatch:
+        (key_data [1, ...], temperature [1], top_k [1], top_p [1])."""
+        t, k, p = params.as_arrays()
+        return (
+            jnp.asarray(self._key_data[slot : slot + 1]),
+            jnp.array([t], jnp.float32),
+            jnp.array([k], jnp.int32),
+            jnp.array([p], jnp.float32),
+        )
+
+    def _emit_first_token(self, slot, req, tok_host, now):
+        """Shared bookkeeping for the TTFT token, whether it came from the
+        one-shot prefill program or the final prefill chunk."""
+        m = self.metrics
+        self.scheduler.note_prefill_token(slot, tok_host, now)
+        m.tokens_out.inc()
+        if not req.params.is_greedy:
+            m.sampled_tokens.inc()
+        m.observe_ttft(req.ttft_s)
+        if req.finished:
+            self._finish(req)
+
+    def _prefill_one(self, slot, req, padded, plen) -> None:
+        """Unchunked prefill: one program over the bucket-padded prompt
+        (the pre-ISSUE-13 path, sampling-aware when enabled)."""
+        sched, m = self.scheduler, self.metrics
+        t0 = time.perf_counter()
+        with trace_span("serve/prefill", track="serve",
+                        request_id=req.rid,
+                        attrs={"padded_len": int(padded.shape[1])}):
+            args = (
+                self.qparams,
+                self.cache.k_pages,
+                self.cache.v_pages,
+                jnp.asarray(padded),
+                jnp.asarray(sched.block_tables[slot : slot + 1]),
+                jnp.array([plen], jnp.int32),
+            )
+            if self._sampling:
+                args += self._sampling_scalar_args(req.params, slot)
+                tok, key_out, row, k_pages, v_pages = self._dispatch(
+                    "serve_prefill", self._prefill_jit, args
+                )
+                self._key_data[slot] = np.asarray(key_out)[0]
+                if self.capture_logits:
+                    self.captured_logits.setdefault(req.rid, []).append(
+                        np.asarray(row)[0].copy()
+                    )
+            else:
+                tok, k_pages, v_pages = self._dispatch(
+                    "serve_prefill", self._prefill_jit, args
+                )
+            self.cache.k_pages, self.cache.v_pages = k_pages, v_pages
+            tok_host = int(np.asarray(tok)[0])  # sync: the TTFT point
+        now = time.perf_counter()
+        m.prefills.inc()
+        m.prefill_s.inc(now - t0)
+        self._emit_first_token(slot, req, tok_host, now)
+
+    def _run_chunk(self, slot, req, toks, positions, is_final,
+                   logit_idx) -> None:
+        """One chunked-prefill step (ISSUE 13): dispatch the fixed-shape
+        chunk program for ``slot``; the final chunk produces the TTFT
+        token.  Only the final chunk syncs to host and advances the
+        request's key stream — one split per emitted token, the same
+        recurrence as unchunked prefill."""
+        sched, m = self.scheduler, self.metrics
+        t0 = time.perf_counter()
+        with trace_span(
+            "serve/prefill_chunk", track="serve", request_id=req.rid,
+            attrs={
+                "start": int(positions[0]),
+                "chunk": int(toks.shape[0]),
+                "final": bool(is_final),
+            },
+        ):
+            args = (
+                self.qparams,
+                self.cache.k_pages,
+                self.cache.v_pages,
+                jnp.asarray(toks[None, :]),
+                jnp.asarray(positions[None, :]),
+                jnp.asarray(sched.block_tables[slot : slot + 1]),
+                jnp.array([int(req.prompt.size)], jnp.int32),
+                jnp.array([logit_idx], jnp.int32),
+            ) + self._sampling_scalar_args(req.params, slot)
+            tok, key_out, row, k_pages, v_pages = self._dispatch(
+                "serve_prefill_chunk", self._chunk_jit, args
+            )
+            self.cache.k_pages, self.cache.v_pages = k_pages, v_pages
+            # EVERY chunk syncs (one [1] token fetch): dispatch is async,
+            # and without the sync the chunk's compute would be charged to
+            # the NEXT decode step's fetch — the serve/prefill_chunk spans
+            # and the prefill goodput bucket must own their real wall
+            tok_host = int(np.asarray(tok)[0])
+        now = time.perf_counter()
+        m.prefill_chunks.inc()
+        m.prefill_s.inc(now - t0)
+        sched.note_chunk(slot)
+        if is_final:
+            self._key_data[slot] = np.asarray(key_out)[0]
+            if self.capture_logits:
+                self.captured_logits.setdefault(req.rid, []).append(
+                    np.asarray(row)[0].copy()
+                )
+            self._emit_first_token(slot, req, tok_host, now)
+
     def step(self) -> bool:
-        """One engine iteration: admit + prefill arrivals, then one decode
-        step over the full slot batch.  Returns True while work remains."""
+        """One engine iteration: admit arrivals (short prompts prefill
+        whole; long ones enter the chunked-prefill state), run at most ONE
+        prefill chunk, then one decode step over the fully-prefilled slot
+        batch.  Bounding per-iteration prefill work by the chunk size is
+        what keeps in-flight TPOT flat while a long prompt admits.
+        Returns True while work remains."""
         sched = self.scheduler
         m = self.metrics
 
@@ -353,64 +649,76 @@ class ServingEngine:
                     track="serve", request_id=req.rid,
                     attrs={"prompt_len": plen}, count_self=False,
                 )
-            t0 = time.perf_counter()
-            with trace_span("serve/prefill", track="serve",
-                            request_id=req.rid,
-                            attrs={"padded_len": int(padded.shape[1])}):
-                tok, k_pages, v_pages = self._dispatch(
-                    "serve_prefill",
-                    self._prefill_jit,
-                    (
-                        self.qparams,
-                        self.cache.k_pages,
-                        self.cache.v_pages,
-                        jnp.asarray(padded),
-                        jnp.asarray(sched.block_tables[slot : slot + 1]),
-                        jnp.array([plen], jnp.int32),
-                    ),
-                )
-                self.cache.k_pages, self.cache.v_pages = k_pages, v_pages
-                tok_host = int(np.asarray(tok)[0])  # sync: the TTFT point
-            now = time.perf_counter()
-            m.prefills.inc()
-            m.prefill_s.inc(now - t0)
-            sched.note_prefill_token(slot, tok_host, now)
-            m.tokens_out.inc()
-            m.observe_ttft(req.ttft_s)
-            if req.finished:
-                self._finish(req)
+            if self._sampling or self._chunk_jit is not None:
+                self._key_data[slot] = initial_key_data(req.seed)
+            if padded is None:
+                continue  # chunked admission: chunks run below
+            self._prefill_one(slot, req, padded, plen)
 
-        if sched.active > 0:
-            # the live slots' request ids BEFORE the commit evicts any —
-            # each gets a per-request decode-slice span below
+        nxt = sched.next_chunk()
+        if nxt is not None:
+            self._run_chunk(*nxt)
+
+        if sched.decoding > 0:
+            # rows in the decode batch (fully-prefilled slots) BEFORE the
+            # commit evicts any — each gets a per-request decode-slice
+            # span below, and sampling key writebacks target exactly them
+            decode_rows = [
+                i
+                for i, s in enumerate(sched.slots)
+                if s.request is not None and s.prefill_pos is None
+            ]
             live_rids = (
-                [
-                    s.request.rid
-                    for s in sched.slots
-                    if s.request is not None
-                ]
+                [sched.slots[i].request.rid for i in decode_rows]
                 if tracing_active()
                 else None
             )
             t0 = time.perf_counter()
             with trace_span("serve/decode_step", track="serve",
-                            attrs={"active": sched.active}):
+                            attrs={"active": sched.decoding}):
                 tokens, positions, tables, context = sched.decode_batch()
-                next_tok, k_pages, v_pages = self._dispatch(
-                    "serve_decode",
-                    self._decode_jit,
-                    (
-                        self.qparams,
-                        self.cache.k_pages,
-                        self.cache.v_pages,
-                        jnp.asarray(tokens),
-                        jnp.asarray(positions),
-                        jnp.asarray(tables),
-                        jnp.asarray(context),
-                    ),
+                args = (
+                    self.qparams,
+                    self.cache.k_pages,
+                    self.cache.v_pages,
+                    jnp.asarray(tokens),
+                    jnp.asarray(positions),
+                    jnp.asarray(tables),
+                    jnp.asarray(context),
                 )
+                if self._sampling:
+                    temps, ks, ps = sched.sampling_batch()
+                    args += (
+                        jnp.asarray(self._key_data),
+                        jnp.asarray(temps),
+                        jnp.asarray(ks),
+                        jnp.asarray(ps),
+                    )
+                    next_tok, key_out, logits, k_pages, v_pages = (
+                        self._dispatch(
+                            "serve_decode", self._decode_jit, args
+                        )
+                    )
+                else:
+                    next_tok, k_pages, v_pages = self._dispatch(
+                        "serve_decode", self._decode_jit, args
+                    )
                 self.cache.k_pages, self.cache.v_pages = k_pages, v_pages
                 next_host = np.asarray(next_tok)  # sync: tokens stream out
+                if self._sampling:
+                    # advance ONLY the decoding slots' key streams: a
+                    # request's draw sequence depends on its own seed and
+                    # token count, never on who else rode the batch
+                    kd = np.asarray(key_out)
+                    for i in decode_rows:
+                        self._key_data[i] = kd[i]
+                    if self.capture_logits:
+                        larr = np.asarray(logits)
+                        for i in decode_rows:
+                            rid = sched.slots[i].request.rid
+                            self.captured_logits.setdefault(rid, []).append(
+                                larr[i].copy()
+                            )
             now = time.perf_counter()
             if live_rids:
                 # per-request decode slices: every live request's timeline
@@ -424,9 +732,16 @@ class ServingEngine:
                               request_id=rid, count_self=False)
             m.decode_steps.inc()
             m.decode_s.inc(now - t0)
+            n_sampled = sum(
+                1
+                for i in decode_rows
+                if not sched.slots[i].request.params.is_greedy
+            )
             was_finished = set(sched.finished)
             live = sched.commit_decode(next_host, now)
             m.tokens_out.inc(live)
+            if n_sampled:
+                m.sampled_tokens.inc(n_sampled)
             for rid in set(sched.finished) - was_finished:
                 self._finish(sched.finished[rid])
 

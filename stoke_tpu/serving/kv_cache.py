@@ -41,6 +41,8 @@ from stoke_tpu.models.bert import dense_attention
 from stoke_tpu.ops.flash_attention import (
     flash_attention,
     paged_decode_attention,
+    paged_decode_attention_pallas,
+    paged_prefill_chunk_attention,
 )
 
 #: block id every unused block-table entry (and every inactive slot) points
@@ -174,12 +176,21 @@ class PagedAttentionHook:
         positions: ``[B, L] int32`` token positions being written this
             call (prefill: ``arange`` rows; decode: each slot's current
             position, L == 1).
-        mode: ``"prefill"`` or ``"decode"``.
-        lengths: ``[B] int32`` — prefill: true prompt lengths (padding
-            positions write to the scratch block and are masked); decode:
-            context lengths INCLUDING the fresh token.
-        attention_impl: prefill kernel, ``"dense"`` or ``"flash"``
-            (decode always reads the paged pool).
+        mode: ``"prefill"``, ``"chunk"`` (chunked prefill, ISSUE 13), or
+            ``"decode"``.
+        lengths: ``[B] int32`` — prefill/chunk: true prompt lengths
+            (padding positions write to the scratch block and are
+            masked); decode: context lengths INCLUDING the fresh token.
+        attention_impl: prefill kernel, ``"dense"`` or ``"flash"``.
+        decode_impl: decode kernel — ``"reference"`` (the jnp
+            gathered-block :func:`paged_decode_attention`) or
+            ``"pallas"`` (the ISSUE 13 streaming kernel
+            :func:`paged_decode_attention_pallas`).
+        decode_pages_per_block / decode_block_h: the pallas kernel's
+            block knobs (``None`` = its defaults; autotune catalog
+            entries).
+        decode_interpret: run the pallas kernel through the interpreter
+            (``None`` = auto off-TPU — the CPU parity mode).
     """
 
     def __init__(
@@ -192,9 +203,18 @@ class PagedAttentionHook:
         mode: str,
         lengths,
         attention_impl: str = "dense",
+        decode_impl: str = "reference",
+        decode_pages_per_block: Optional[int] = None,
+        decode_block_h: Optional[int] = None,
+        decode_interpret: Optional[bool] = None,
     ):
-        if mode not in ("prefill", "decode"):
+        if mode not in ("prefill", "chunk", "decode"):
             raise ValueError(f"unknown PagedAttentionHook mode {mode!r}")
+        if decode_impl not in ("reference", "pallas"):
+            raise ValueError(
+                f"unknown PagedAttentionHook decode_impl {decode_impl!r}; "
+                f"valid: ['reference', 'pallas']"
+            )
         self.k_pages = k_pages
         self.v_pages = v_pages
         self.block_tables = block_tables
@@ -202,6 +222,10 @@ class PagedAttentionHook:
         self.mode = mode
         self.lengths = lengths
         self.attention_impl = attention_impl
+        self.decode_impl = decode_impl
+        self.decode_pages_per_block = decode_pages_per_block
+        self.decode_block_h = decode_block_h
+        self.decode_interpret = decode_interpret
         self.block_size = int(k_pages.shape[2])
 
     # ------------------------------ writes ----------------------------- #
@@ -219,7 +243,10 @@ class PagedAttentionHook:
         pos = self.positions.reshape(-1)  # [B*L]
         slot = jnp.repeat(jnp.arange(B, dtype=jnp.int32), L)
         blk_idx = pos // self.block_size
-        if self.mode == "prefill":
+        if self.mode in ("prefill", "chunk"):
+            # chunk rows past the prompt end (the last chunk's padding)
+            # carry clamped positions >= the prompt length, so the same
+            # predicate steers them to scratch
             valid = (
                 self.positions
                 < self.lengths[:, None].astype(self.positions.dtype)
@@ -251,12 +278,35 @@ class PagedAttentionHook:
                 )
             self._write_layer(layer, k, v)
             if self.mode == "decode":
+                if self.decode_impl == "pallas":
+                    return paged_decode_attention_pallas(
+                        q,
+                        self.k_pages[layer],
+                        self.v_pages[layer],
+                        self.block_tables,
+                        self.lengths,
+                        pages_per_block=self.decode_pages_per_block,
+                        block_h=self.decode_block_h,
+                        interpret=self.decode_interpret,
+                    )
                 return paged_decode_attention(
                     q,
                     self.k_pages[layer],
                     self.v_pages[layer],
                     self.block_tables,
                     self.lengths,
+                )
+            if self.mode == "chunk":
+                # chunked prefill: the chunk's K/V were just written, so
+                # attention is one paged gather masked causally by GLOBAL
+                # position — earlier chunks' prefix and the intra-chunk
+                # causal mask fall out of the same predicate
+                return paged_prefill_chunk_attention(
+                    q,
+                    self.k_pages[layer],
+                    self.v_pages[layer],
+                    self.block_tables,
+                    self.positions,
                 )
             # prefill: ordinary causal attention over the (padded) prompt
             # — the pages were just written for DECODE's benefit; the

@@ -1,0 +1,171 @@
+"""Sampling beyond greedy: temperature / top-k / top-p with per-request
+seeds (ISSUE 13 pillar 3).
+
+Greedy argmax was a design choice, not a limitation — the PR-9
+continuous-batching acceptance (staggered admission produces token
+streams identical to sequential generation) leans on decode determinism.
+Sampling keeps every piece of that determinism except the final draw:
+
+- **Device-side sampling**: :func:`sample_tokens` runs INSIDE the compiled
+  prefill/decode programs on the pre-sampling logits (temperature scale →
+  top-k mask → top-p nucleus mask → Gumbel-max draw), so the host never
+  sees probabilities and the decode dispatch count is unchanged.
+- **Per-request typed PRNG keys**: each request owns a key stream seeded
+  at submit (explicit ``SamplingParams.seed``, else a deterministic
+  per-request default).  The key state is *engine state threaded through
+  the dispatch* exactly like the KV pages: the program wraps the raw key
+  data to typed keys (``jax.random.wrap_key_data``), splits once per
+  emitted token, samples with the subkey, and returns the advanced key
+  data.  One split per token means a request's draw sequence depends only
+  on its own seed and token index — never on co-batched requests — so
+  seeded runs are reproducible and staggered == sequential extends to
+  sampled streams.
+- **Counterfactual parity**: ``temperature == 0`` routes to the exact raw
+  argmax (``jnp.where``, not a small-temperature limit), so temperature→0
+  reproduces greedy streams BIT-exactly; and because sampling happens
+  after the logits, the pre-sampling logits of a staggered batch bit-match
+  sequential generation (the acceptance check that replaces greedy stream
+  equality when streams are stochastic).
+
+Per-request knobs travel as fixed-shape ``[B]`` arrays (0 temperature =
+greedy, 0 top_k = disabled, 1.0 top_p = disabled) so the decode program
+shape never changes with the request mix.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+#: wire encoding of "knob disabled" in the fixed-shape per-slot arrays
+TOP_K_OFF = 0
+TOP_P_OFF = 1.0
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling knobs (validated at ``submit()``).
+
+    Attributes:
+        temperature: softmax temperature; ``0.0`` is EXACT greedy (the raw
+            argmax, not a limit — the determinism the batching acceptance
+            tests lean on).
+        top_k: keep only the k highest logits before drawing (``None`` =
+            off; ``1`` degenerates to greedy whatever the temperature).
+        top_p: nucleus sampling — keep the smallest prefix of the sorted
+            distribution whose mass reaches ``top_p`` (``None`` = off;
+            the most-probable token is always kept).
+        seed: PRNG seed of this request's draw stream (``None`` = the
+            engine derives a deterministic per-request default from
+            ``ServeConfig.sampling_seed`` and the request id).
+    """
+
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    seed: Optional[int] = None
+
+    @property
+    def is_greedy(self) -> bool:
+        """True when the draw is the raw argmax (temperature 0)."""
+        return self.temperature == 0.0
+
+    def as_arrays(self) -> Tuple[float, int, float]:
+        """The ``(temperature, top_k, top_p)`` wire triple (disabled knobs
+        encoded as ``TOP_K_OFF``/``TOP_P_OFF``)."""
+        return (
+            float(self.temperature),
+            TOP_K_OFF if self.top_k is None else int(self.top_k),
+            TOP_P_OFF if self.top_p is None else float(self.top_p),
+        )
+
+
+def validate_sampling_params(p: SamplingParams) -> None:
+    """Reject impossible knobs at submit time, not mid-decode."""
+    if p.temperature < 0.0:
+        raise ValueError(
+            f"SamplingParams.temperature must be >= 0, got {p.temperature}"
+        )
+    if p.top_k is not None and p.top_k < 1:
+        raise ValueError(
+            f"SamplingParams.top_k must be >= 1 when set, got {p.top_k}"
+        )
+    if p.top_p is not None and not (0.0 < p.top_p <= 1.0):
+        raise ValueError(
+            f"SamplingParams.top_p must be in (0, 1] when set, got {p.top_p}"
+        )
+
+
+def initial_key_data(seed: int) -> np.ndarray:
+    """Raw key data of a fresh typed key for ``seed`` — the per-slot key
+    state the engine threads through its dispatches."""
+    return np.asarray(jax.random.key_data(jax.random.key(int(seed))))
+
+
+def split_key_data(key_data):
+    """Advance a ``[B, ...]`` key-data batch one step INSIDE a compiled
+    program: wrap to typed keys, split each once, return
+    ``(carry_key_data, draw_keys)`` — the carry becomes the next step's
+    state, the typed draw keys feed :func:`sample_tokens`."""
+    keys = jax.random.wrap_key_data(key_data)
+    pairs = jax.vmap(lambda k: jax.random.split(k))(keys)
+    carry, sub = pairs[:, 0], pairs[:, 1]
+    return jax.vmap(jax.random.key_data)(carry), sub
+
+
+def sample_tokens(logits, keys, temperature, top_k, top_p):
+    """Batched temperature / top-k / top-p sampling (device-side).
+
+    Args:
+        logits: ``[B, V]`` pre-sampling logits.
+        keys: ``[B]`` typed PRNG keys (one fresh subkey per slot per
+            token — see :func:`split_key_data`).
+        temperature: ``[B] f32`` — 0 selects the EXACT raw argmax.
+        top_k: ``[B] i32`` — ``TOP_K_OFF`` (0) disables.
+        top_p: ``[B] f32`` — ``TOP_P_OFF`` (1.0) disables.
+
+    Returns ``[B] int32`` sampled token ids.
+    """
+    logits = logits.astype(jnp.float32)
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    t = jnp.maximum(temperature.astype(jnp.float32), 1e-6)[:, None]
+    scaled = logits / t
+    # top-k: mask below the k-th largest scaled logit (k = V when off)
+    k_eff = jnp.where(
+        top_k > 0, jnp.clip(top_k, 1, V), V
+    ).astype(jnp.int32)
+    desc = -jnp.sort(-scaled, axis=-1)
+    kth = jnp.take_along_axis(desc, (k_eff - 1)[:, None], axis=-1)
+    masked = jnp.where(scaled >= kth, scaled, _NEG_INF)
+    # top-p: keep the smallest sorted prefix whose mass reaches p; the
+    # `cum - p_i < p` predicate always keeps the most-probable token.
+    # Softmax is order-preserving, so the sorted probabilities come from
+    # the ALREADY-sorted logits (top-k tail masked positionally) — one
+    # O(V log V) sort per step — and the cutoff maps back through the
+    # LOGIT at the nucleus boundary: ``desc`` is a bitwise permutation of
+    # the kept ``masked`` values, so `masked >= thr_logit` is exact (a
+    # probability-space comparison against a separately-summed softmax
+    # can drop the boundary token on ulp-level rounding)
+    rank = jax.lax.broadcasted_iota(jnp.int32, (B, V), 1)
+    pdesc = jax.nn.softmax(
+        jnp.where(rank < k_eff[:, None], desc, _NEG_INF), axis=-1
+    )
+    csum = jnp.cumsum(pdesc, axis=-1)
+    p_lim = jnp.clip(top_p.astype(jnp.float32), 0.0, 1.0)[:, None]
+    keep_n = jnp.maximum(jnp.sum((csum - pdesc) < p_lim, axis=-1), 1)
+    thr_logit = jnp.take_along_axis(desc, (keep_n - 1)[:, None], axis=-1)
+    final = jnp.where(masked >= thr_logit, masked, _NEG_INF)
+    # Gumbel-max draw: argmax(log-weights + gumbel) ~ categorical
+    g = jax.vmap(lambda k: jax.random.gumbel(k, (V,), jnp.float32))(keys)
+    sampled = jnp.argmax(
+        jnp.where(final > _NEG_INF * 0.5, final + g, _NEG_INF), axis=-1
+    ).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy)

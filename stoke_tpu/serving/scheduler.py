@@ -37,6 +37,7 @@ import numpy as np
 
 from stoke_tpu.native import NativeBatcher
 from stoke_tpu.serving.kv_cache import SCRATCH_BLOCK, BlockAllocator
+from stoke_tpu.serving.sampling import SamplingParams
 
 
 @dataclass
@@ -46,12 +47,17 @@ class Request:
     ``tokens`` accumulates the generated ids (the first one comes from
     prefill — its wall time IS the TTFT); ``first_token_ts - arrival_ts``
     and the per-token deltas after it feed the TTFT/TPOT histograms.
+    ``params``/``seed`` are the resolved sampling knobs (ISSUE 13): the
+    engine resolves defaults at submit, so the scheduler only carries
+    them.
     """
 
     rid: int
     prompt: np.ndarray
     max_new_tokens: int
     eos_id: Optional[int] = None
+    params: SamplingParams = field(default_factory=SamplingParams)
+    seed: int = 0
     arrival_ts: float = field(default_factory=time.perf_counter)
     admit_ts: Optional[float] = None
     first_token_ts: Optional[float] = None
@@ -83,6 +89,12 @@ class _Slot:
     blocks: List[int] = field(default_factory=list)
     context_len: int = 0       # cached tokens (prompt + committed decode)
     next_token: int = 0        # token the next decode step feeds
+    # chunked prefill (ISSUE 13): prompt tokens already written to the
+    # cache; None = prefill complete (the slot decodes).  While a slot is
+    # prefilling it occupies capacity but is excluded from decode_batch —
+    # its rows run against the scratch table like an inactive slot, so
+    # in-flight decode writes can never clobber its half-written prompt.
+    prefill_pos: Optional[int] = None
 
 
 class Scheduler:
@@ -98,6 +110,8 @@ class Scheduler:
         default_max_new_tokens: int,
         eos_id: Optional[int] = None,
         pad_multiple: int = 64,
+        prefill_chunk_tokens: Optional[int] = None,
+        sampling_seed_base: int = 0,
         batcher: Optional[NativeBatcher] = None,
     ):
         self.max_seqs = int(max_seqs)
@@ -107,6 +121,10 @@ class Scheduler:
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.eos_id = eos_id
         self.pad_multiple = int(pad_multiple)
+        self.prefill_chunk_tokens = (
+            None if prefill_chunk_tokens is None else int(prefill_chunk_tokens)
+        )
+        self.sampling_seed_base = int(sampling_seed_base)
         self.batcher = batcher or NativeBatcher()
         self.queue: Deque[Request] = deque()
         self.slots: List[_Slot] = [_Slot() for _ in range(max_seqs)]
@@ -125,6 +143,7 @@ class Scheduler:
         prompt,
         max_new_tokens: Optional[int] = None,
         eos_id: Optional[int] = None,
+        params: Optional[SamplingParams] = None,
     ) -> int:
         """Enqueue one request; returns its id.  Requests whose worst case
         cannot fit ``max_seq_len`` are rejected here — a cap the paged
@@ -146,12 +165,24 @@ class Scheduler:
             )
         rid = self._next_rid
         self._next_rid += 1
+        params = params if params is not None else SamplingParams()
+        # seed resolution lives HERE, beside rid assignment: an explicit
+        # per-request seed wins, else the deterministic per-request
+        # default sampling_seed_base + rid — so whole runs replay from
+        # the config and the derivation can never desync from the rid
+        seed = (
+            params.seed
+            if params.seed is not None
+            else self.sampling_seed_base + rid
+        )
         self.queue.append(
             Request(
                 rid=rid,
                 prompt=prompt,
                 max_new_tokens=cap,
                 eos_id=self.eos_id if eos_id is None else eos_id,
+                params=params,
+                seed=int(seed),
             )
         )
         return rid
@@ -161,6 +192,20 @@ class Scheduler:
     @property
     def active(self) -> int:
         return sum(1 for s in self.slots if s.request is not None)
+
+    @property
+    def decoding(self) -> int:
+        """Slots with a fully-prefilled request — the live decode batch
+        (a chunk-prefilling slot occupies capacity but does not decode)."""
+        return sum(
+            1
+            for s in self.slots
+            if s.request is not None and s.prefill_pos is None
+        )
+
+    @property
+    def has_prefilling(self) -> bool:
+        return any(s.prefill_pos is not None for s in self.slots)
 
     @property
     def queued(self) -> int:
@@ -174,12 +219,19 @@ class Scheduler:
     def batch_fill(self) -> float:
         return self.active / max(self.max_seqs, 1)
 
-    def admit(self) -> List[Tuple[int, Request, np.ndarray, int]]:
+    def admit(self) -> List[Tuple[int, Request, Optional[np.ndarray], int]]:
         """Admit queued requests (FIFO) while a slot and their block
         budget are free.  Returns ``[(slot, request, padded_prompt,
         prompt_len), ...]`` for the engine to prefill; the padded prompt
         comes from the native ``gather_pad`` path (zero-pad to the
-        ``pad_multiple`` bucket that keys the compiled prefill program)."""
+        ``pad_multiple`` bucket that keys the compiled prefill program).
+
+        Chunked prefill (ISSUE 13): when ``prefill_chunk_tokens`` is set
+        and the prompt is longer, the slot is admitted in the PREFILLING
+        state instead (``padded_prompt`` is None) — the engine pulls
+        fixed-size chunks via :meth:`next_chunk` across later iterations,
+        interleaved with decode steps, so one long prompt cannot stall
+        the in-flight batch."""
         admitted = []
         for i, slot in enumerate(self.slots):
             if not self.queue:
@@ -203,6 +255,11 @@ class Scheduler:
             slot.context_len = int(req.prompt.size)
             self.block_tables[i, :] = SCRATCH_BLOCK
             self.block_tables[i, : len(blocks)] = blocks
+            chunk = self.prefill_chunk_tokens
+            if chunk is not None and req.prompt.size > chunk:
+                slot.prefill_pos = 0
+                admitted.append((i, req, None, int(req.prompt.size)))
+                continue
             padded, _mask = self.batcher.gather_pad(
                 req.prompt,
                 np.zeros(1, np.int64),
@@ -213,23 +270,91 @@ class Scheduler:
             admitted.append((i, req, padded, int(req.prompt.size)))
         return admitted
 
+    # ------------------------- chunked prefill -------------------------- #
+
+    def next_chunk(self):
+        """The next prompt chunk to prefill, or None.  One chunk per
+        engine iteration keeps every iteration's prefill work bounded by
+        ``prefill_chunk_tokens`` — the TPOT-flatness guarantee.  The
+        OLDEST-admitted prefilling request is serviced first (FIFO over
+        admit_ts, not slot index): a later long prompt recycling a lower
+        slot must never starve one already mid-prefill.  Returns
+        ``(slot, request, tokens [C], positions [C], is_final,
+        logit_idx)``: tokens zero-padded to the fixed chunk length (ONE
+        compiled chunk program), positions the GLOBAL prompt positions
+        (padding rows clamped — their writes steer to scratch, their
+        outputs are discarded), ``logit_idx`` the in-chunk row of the
+        last prompt token (meaningful only when ``is_final``)."""
+        C = self.prefill_chunk_tokens
+        prefilling = [
+            (s.request.admit_ts, i, s)
+            for i, s in enumerate(self.slots)
+            if s.prefill_pos is not None
+        ]
+        if not prefilling:
+            return None
+        _, i, s = min(prefilling)
+        req = s.request
+        plen = int(req.prompt.size)
+        start = s.prefill_pos
+        toks = np.zeros(C, np.int32)
+        n = min(C, plen - start)
+        toks[:n] = req.prompt[start : start + n]
+        positions = np.minimum(
+            start + np.arange(C, dtype=np.int32), self.max_seq_len - 1
+        )
+        is_final = start + C >= plen
+        logit_idx = plen - 1 - start if is_final else 0
+        return i, req, toks, positions, is_final, logit_idx
+
+    def note_chunk(self, slot: int) -> None:
+        """One chunk dispatched for ``slot``: advance the prefill cursor;
+        the final chunk completes prefill (the engine then records the
+        sampled first token via :meth:`note_prefill_token`, arming
+        decode)."""
+        s = self.slots[slot]
+        s.prefill_pos += self.prefill_chunk_tokens
+        if s.prefill_pos >= s.request.prompt.size:
+            s.prefill_pos = None
+
     # --------------------------- decode state -------------------------- #
 
     def decode_batch(self):
         """Fixed-shape decode inputs: ``(tokens [B], positions [B],
         block_tables [B, MB], context_lens [B])``.  Inactive slots feed
-        token 0 at position 0 against an all-scratch table."""
+        token 0 at position 0 against an all-scratch table; slots still
+        chunk-prefilling get the SAME treatment (their real table is
+        swapped for scratch here) so the decode step's position-0 write
+        can never clobber their half-written prompt K/V."""
         B = self.max_seqs
         tokens = np.zeros(B, np.int32)
         positions = np.zeros(B, np.int32)
         context = np.ones(B, np.int32)  # inactive: attend self-only
+        tables = self.block_tables.copy()
         for i, s in enumerate(self.slots):
             if s.request is None:
+                continue
+            if s.prefill_pos is not None:
+                tables[i, :] = SCRATCH_BLOCK
                 continue
             tokens[i] = s.next_token
             positions[i] = s.context_len
             context[i] = s.context_len + 1
-        return tokens, positions, self.block_tables.copy(), context
+        return tokens, positions, tables, context
+
+    def sampling_batch(self):
+        """Fixed-shape per-slot sampling knobs aligned with
+        :meth:`decode_batch`: ``(temperature [B] f32, top_k [B] i32,
+        top_p [B] f32)`` — inactive/prefilling slots greedy-encoded."""
+        B = self.max_seqs
+        temps = np.zeros(B, np.float32)
+        ks = np.zeros(B, np.int32)
+        ps = np.ones(B, np.float32)
+        for i, s in enumerate(self.slots):
+            if s.request is None or s.prefill_pos is not None:
+                continue
+            temps[i], ks[i], ps[i] = s.request.params.as_arrays()
+        return temps, ks, ps
 
     # --------------------------- commit/evict --------------------------- #
 
@@ -250,7 +375,7 @@ class Scheduler:
         LIVE tokens committed (inactive-slot outputs are discarded)."""
         live = 0
         for i, s in enumerate(self.slots):
-            if s.request is None:
+            if s.request is None or s.prefill_pos is not None:
                 continue
             tok = int(next_tokens[i])
             s.context_len += 1  # the token we just fed is now cached

@@ -91,8 +91,17 @@ class ServeMetrics:
         self.prefills = registry.counter(
             "serve/prefills_total", help="prefill program dispatches"
         )
+        self.prefill_chunks = registry.counter(
+            "serve/prefill_chunks_total",
+            help="chunked-prefill program dispatches (ISSUE 13)",
+        )
         self.decode_steps = registry.counter(
             "serve/decode_steps_total", help="decode program dispatches"
+        )
+        self.sampled_tokens = registry.counter(
+            "serve/sampled_tokens_total",
+            help="tokens drawn through the sampling path "
+            "(temperature > 0; greedy tokens excluded)",
         )
         # goodput buckets (sums-to-wall: queue = wall - prefill - decode)
         self.prefill_s = registry.counter(
@@ -194,6 +203,8 @@ class ServeMetrics:
             "serve/goodput_queue_s": self.queue_s.value,
             "serve/goodput_prefill_s": self.prefill_s.value,
             "serve/goodput_decode_s": self.decode_s.value,
+            "serve/prefill_chunks": self.prefill_chunks.value,
+            "serve/sampled_tokens": self.sampled_tokens.value,
             "serve/quant_compression": (
                 self.quant_compression.value
                 if self.quant_compression.has_value

@@ -56,6 +56,7 @@ from stoke_tpu.configs import (
     ResilienceConfig,
     SDDPConfig,
     SERVE_ATTENTION_KERNELS,
+    SERVE_DECODE_KERNELS,
     SERVE_KV_DTYPES,
     SERVE_QUANT_MODES,
     ServeConfig,
@@ -849,6 +850,94 @@ class StokeStatus:
                 return (
                     f"ServeConfig.attention {cfg.attention!r} unknown; "
                     f"valid: {list(SERVE_ATTENTION_KERNELS)}"
+                )
+            if cfg.decode_kernel not in SERVE_DECODE_KERNELS:
+                return (
+                    f"ServeConfig.decode_kernel {cfg.decode_kernel!r} "
+                    f"unknown; valid: {list(SERVE_DECODE_KERNELS)}"
+                )
+            if (
+                cfg.decode_kernel == "pallas"
+                and s["device"] is DeviceOptions.cpu
+            ):
+                # the streaming kernel is a TPU fast path; a REAL serve
+                # config on a CPU device would silently run the pallas
+                # INTERPRETER (orders of magnitude slower than the
+                # reference kernel it exists to beat).  Tests exercise
+                # interpreter parity through ServingEngine directly.
+                return (
+                    "ServeConfig.decode_kernel='pallas' on device='cpu': "
+                    "the streaming decode kernel needs a TPU backend — "
+                    "use decode_kernel='reference' on CPU (the pallas "
+                    "interpreter parity mode is for tests, via a "
+                    "standalone ServingEngine)"
+                )
+            for field in ("decode_pages_per_block", "decode_block_h"):
+                v = getattr(cfg, field)
+                if v is not None and v < 1:
+                    return (
+                        f"ServeConfig.{field} must be >= 1 when set, "
+                        f"got {v}"
+                    )
+                if v is not None and cfg.decode_kernel != "pallas":
+                    # same contract as the sampling-knob rule below: a
+                    # knob the selected kernel never reads is rejected,
+                    # never silently ignored
+                    return (
+                        f"ServeConfig.{field}={v} set but decode_kernel="
+                        f"{cfg.decode_kernel!r} — only the pallas "
+                        f"streaming kernel reads the block knobs; set "
+                        f"decode_kernel='pallas' or drop the knob"
+                    )
+            if cfg.prefill_chunk_tokens is not None:
+                c = cfg.prefill_chunk_tokens
+                if c < 1:
+                    return (
+                        f"ServeConfig.prefill_chunk_tokens must be >= 1, "
+                        f"got {c}"
+                    )
+                if c % cfg.prefill_pad_multiple:
+                    return (
+                        f"ServeConfig.prefill_chunk_tokens={c} must be a "
+                        f"multiple of prefill_pad_multiple="
+                        f"{cfg.prefill_pad_multiple} — chunk shapes ride "
+                        f"the same bucket discipline that bounds compiled-"
+                        f"program count"
+                    )
+                if c > cfg.max_seq_len:
+                    return (
+                        f"ServeConfig.prefill_chunk_tokens={c} exceeds "
+                        f"max_seq_len={cfg.max_seq_len} — no prompt could "
+                        f"ever be chunked"
+                    )
+            if cfg.temperature < 0.0:
+                return (
+                    f"ServeConfig.temperature must be >= 0, got "
+                    f"{cfg.temperature}"
+                )
+            if cfg.top_k is not None and cfg.top_k < 1:
+                return (
+                    f"ServeConfig.top_k must be >= 1 when set, got "
+                    f"{cfg.top_k}"
+                )
+            if cfg.top_p is not None and not (0.0 < cfg.top_p <= 1.0):
+                return (
+                    f"ServeConfig.top_p must be in (0, 1] when set, got "
+                    f"{cfg.top_p}"
+                )
+            if not cfg.sampling and (
+                cfg.temperature != 0.0
+                or cfg.top_k is not None
+                or cfg.top_p is not None
+            ):
+                # a sampled-looking config that silently serves greedy is
+                # the chaos-spec anti-pattern: never ignore, always name
+                # the remedy
+                return (
+                    "ServeConfig sampling knobs set (temperature/top_k/"
+                    "top_p) but sampling=False — the greedy programs "
+                    "would silently ignore them; set sampling=True or "
+                    "drop the knobs"
                 )
             if cfg.quant not in SERVE_QUANT_MODES:
                 return (

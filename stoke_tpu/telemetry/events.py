@@ -176,6 +176,11 @@ STEP_EVENT_FIELDS: Dict[str, tuple] = {
     "serve/goodput_prefill_s": (False, "nullable_number"),
     "serve/goodput_decode_s": (False, "nullable_number"),
     "serve/quant_compression": (False, "nullable_number"),
+    # serve fast path (ISSUE 13): chunked-prefill dispatch count and
+    # tokens drawn through the sampling path (both 0 for a greedy,
+    # unchunked engine — the fields still ride every serve record)
+    "serve/prefill_chunks": (False, "nullable_number"),
+    "serve/sampled_tokens": (False, "nullable_number"),
     # per-layer numerics observatory (ISSUE 12; keys absent without a
     # NumericsConfig): groups is the fixed group count of the run's param
     # tree; per_group the nullable {group: {stat: value}} block (grad/

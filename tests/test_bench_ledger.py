@@ -158,3 +158,94 @@ def test_persist_result_keep_best(ledger):
     # accuracy_run order by backend/precision, not value alone)
     bench.persist_result("m", {"value": 42.0, "backend": "tpu"})
     assert bench._load_results()["m"]["value"] == 42.0
+
+
+def test_emit_persisted_stale_rows_carry_capture_date(ledger, capsys):
+    """ISSUE 13 satellite: a stale emit is self-describing — the capture
+    date of the restated value rides the row (stale_since) AND the
+    human-read note, so '9257 imgs/s/chip (stale since 2026-07-29)' needs
+    no tribal knowledge to decode."""
+    bench.persist_result("m", {"value": 9257.0, "unit": "imgs/sec/chip",
+                               "date": "2026-07-29", "backend": "tpu"})
+    rc, out = _emit(capsys, "m")
+    assert rc == 0
+    assert out["stale"] is True
+    assert out["stale_since"] == "2026-07-29"
+    assert "2026-07-29" in out["note"]
+
+
+def test_emit_persisted_stale_date_unknown_still_emits(ledger, capsys):
+    # legacy record without a date: the row still emits, the note says so
+    bench.persist_result("m", {"value": 9000.0, "backend": "tpu"})
+    rc, out = _emit(capsys, "m")
+    assert rc == 0
+    assert out["stale_since"] is None
+    assert "unknown date" in out["note"]
+
+
+def test_emit_persisted_serve_fastpath_columns_ride_stale_emit(
+    ledger, capsys
+):
+    """A re-cited serve capture carries its decode-kernel / chunking /
+    sampling descriptor (ISSUE 13 config keys) so consumers see WHICH
+    serve configuration the stale number measured."""
+    bench.persist_result(
+        "gpt_small_serve_throughput",
+        {"value": 1234.0, "unit": "tokens/sec", "date": "2026-08-01",
+         "backend": "tpu", "serve": True, "serve_quant": "int8",
+         "serve_max_seqs": 8, "serve_decode_kernel": "pallas",
+         "serve_prefill_chunk": 128, "serve_sampling": "topp"},
+    )
+    rc, out = _emit(capsys, "gpt_small_serve_throughput")
+    assert rc == 0
+    assert out["serve_decode_kernel"] == "pallas"
+    assert out["serve_prefill_chunk"] == 128
+    assert out["serve_sampling"] == "topp"
+
+
+def test_emit_persisted_refuses_serve_decode_kernel_mismatch(
+    ledger, capsys
+):
+    # a reference-kernel record is never substituted for a pallas request
+    bench.persist_result(
+        "gpt_small_serve_throughput",
+        {"value": 1234.0, "date": "2026-08-01", "backend": "tpu",
+         "serve": True, "serve_decode_kernel": "reference"},
+    )
+    rc, out = _emit(
+        capsys, "gpt_small_serve_throughput",
+        requested={"serve_decode_kernel": "pallas"},
+    )
+    assert rc == 1
+    assert "serve_decode_kernel" in out["error"]
+
+
+def test_emit_persisted_default_run_refuses_fastpath_record(ledger, capsys):
+    """Symmetry of the guard: a DEFAULT (reference/greedy) serve run never
+    cites a pallas or topp capture — absent ledger keys normalize to the
+    pre-fast-path defaults, so the mismatch fires in both directions."""
+    bench.persist_result(
+        "gpt_small_serve_throughput",
+        {"value": 2000.0, "date": "2026-08-02", "backend": "tpu",
+         "serve": True, "serve_decode_kernel": "pallas",
+         "serve_sampling": "topp"},
+    )
+    rc, out = _emit(
+        capsys, "gpt_small_serve_throughput",
+        requested={"serve_decode_kernel": "reference",
+                   "serve_sampling": "greedy"},
+    )
+    assert rc == 1
+    # and a legacy record WITHOUT the keys satisfies a default request
+    bench.persist_result(
+        "gpt_small_serve_throughput",
+        {"value": 1000.0, "date": "2026-07-01", "backend": "tpu",
+         "serve": True},
+    )
+    rc, out = _emit(
+        capsys, "gpt_small_serve_throughput",
+        requested={"serve_decode_kernel": "reference",
+                   "serve_sampling": "greedy",
+                   "serve_long_prompt": False},
+    )
+    assert rc == 0 and out["value"] == 1000.0
