@@ -7,7 +7,7 @@
 CPU_ENV = env PYTHONPATH=$(CURDIR) JAX_PLATFORMS=cpu
 MESH_ENV = $(CPU_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-full test-fast test-telemetry test-collectives test-health test-attribution test-fleet test-autotune test-resilience test-zero test-serving test-tracing test-numerics autotune-smoke dryrun bench-smoke telemetry-smoke serve-smoke tpu-probe
+.PHONY: test test-full test-fast test-telemetry test-collectives test-health test-attribution test-fleet test-autotune test-resilience test-zero test-serving test-tracing test-numerics test-elastic autotune-smoke dryrun bench-smoke telemetry-smoke serve-smoke tpu-probe
 
 test:            ## default tier (excludes @slow compile-heavy equivalence tests)
 	$(MESH_ENV) python -m pytest tests/ -x -q
@@ -53,6 +53,9 @@ test-tracing:    ## structured-tracing tests only (span ring/nesting/Perfetto sc
 
 test-numerics:   ## per-layer numerics tests only (module groups/provenance/quant attribution/diff tool)
 	$(MESH_ENV) python -m pytest tests/ -x -q -m numerics
+
+test-elastic:    ## elastic-resilience tests only (staged saves/elastic resume/rebalancing/kill_during_save)
+	$(MESH_ENV) python -m pytest tests/ -x -q -m elastic
 
 serve-smoke:     ## CPU-safe serve smoke: traced chunked-prefill + top-p request end-to-end, then the Poisson trace arm (never touches the tunnel)
 	$(MESH_ENV) python scripts/telemetry_smoke.py --serve-only

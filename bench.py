@@ -1108,6 +1108,13 @@ def main():
         jax.tree_util.tree_map(np.asarray, variables)
         if args.numerics else None
     )
+    # same hazard for the --resilience elastic-resume probe's half-mesh
+    # facade (ISSUE 14): it constructs AFTER the measured run donated the
+    # init arrays
+    elastic_variables = (
+        jax.tree_util.tree_map(np.asarray, variables)
+        if args.resilience else None
+    )
     run_configs = []
     shard_tier = args.comm_shard_tier
     if args.comm_dtype:
@@ -1527,6 +1534,97 @@ def main():
         result["preemptions"] = rz.get("preemptions")
         result["emergency_saves"] = rz.get("emergency_saves")
         result["quarantined_ckpts"] = rz.get("quarantined_ckpts")
+        # ISSUE 14 columns on the same geometry: (a) ckpt_stall_s — the
+        # worst step-wall spike while a periodic async save fires, with
+        # the offload staging path vs the legacy main-thread gather; (b)
+        # elastic_resume — a manifest'd save restored onto a HALF-SIZE
+        # mesh, params bit-checked.  Best-effort probes: a failure
+        # records null columns, never kills the capture.
+        import tempfile as _tf
+
+        from stoke_tpu import CheckpointConfig as _CkptCfg
+
+        def _ckpt_stall(offload: bool):
+            cfg = _CkptCfg(async_save=True, offload_staging=offload,
+                           max_to_keep=2)
+            root = _tf.mkdtemp(prefix="stoke-bench-ckptstall-")
+            name = "stall-offload" if offload else "stall-legacy"
+            # warm the save path (first offload save compiles the
+            # snapshot copy program; first legacy save warms the gather)
+            stoke._save_with_config(root, name, cfg, None)
+            stoke.wait_for_checkpoint()
+            walls, save_wall = [], None
+            for i in range(5):
+                t0 = time.perf_counter()
+                jax.block_until_ready(one_step(i))
+                if i == 2:
+                    stoke._save_with_config(root, name, cfg, None)
+                    save_wall = time.perf_counter() - t0
+                else:
+                    walls.append(time.perf_counter() - t0)
+            stoke.wait_for_checkpoint()
+            quiet = sorted(walls)[len(walls) // 2]
+            return max(0.0, save_wall - quiet)
+
+        try:
+            result["ckpt_stall_offload_s"] = round(_ckpt_stall(True), 4)
+            result["ckpt_stall_legacy_s"] = round(_ckpt_stall(False), 4)
+            result["ckpt_stall_s"] = result["ckpt_stall_offload_s"]
+        except Exception as e:
+            print(f"bench: ckpt-stall probe failed: {e!r}", file=sys.stderr)
+            result["ckpt_stall_offload_s"] = None
+            result["ckpt_stall_legacy_s"] = None
+            result["ckpt_stall_s"] = None
+        elastic_ok = None
+        try:
+            mesh = stoke._mesh
+            n_dev = int(mesh.size) if mesh is not None else 1
+            # the probe needs a mesh to shrink: distributed runs only
+            # (single-device captures record null — nothing to re-shard)
+            if n_dev >= 2 and stoke.resilience is not None:
+                from stoke_tpu import MeshConfig as _MeshCfg
+                from stoke_tpu import ResilienceConfig as _RzCfg
+
+                el_root = _tf.mkdtemp(prefix="stoke-bench-elastic-")
+                stoke._save_with_config(
+                    el_root, "emergency", _CkptCfg(), None
+                )
+                from stoke_tpu import TelemetryConfig as _TelCfg
+
+                half = np.array(list(mesh.devices.flat)[: n_dev // 2])
+                half_cfgs = [
+                    _TelCfg(
+                        output_dir=_tf.mkdtemp(
+                            prefix="stoke-bench-elastic-tel-"
+                        ),
+                        log_every_n_steps=10, prometheus=False,
+                        sample_device_time=False,
+                    )
+                    if isinstance(c, _TelCfg)
+                    else c
+                    for c in run_configs
+                    if not isinstance(c, _RzCfg)
+                ] + [
+                    _RzCfg(save_path=el_root),
+                    _MeshCfg(devices=half),
+                ]
+                ref = [
+                    np.asarray(l)
+                    for l in jax.tree_util.tree_leaves(stoke.params)
+                ]
+                half_stoke = _build_stoke(elastic_variables, half_cfgs)
+                elastic_ok = bool(half_stoke.resume()) and all(
+                    np.array_equal(np.asarray(a), b)
+                    for a, b in zip(
+                        jax.tree_util.tree_leaves(half_stoke.params), ref
+                    )
+                )
+                half_stoke.close_telemetry()
+        except Exception as e:
+            print(f"bench: elastic-resume probe failed: {e!r}",
+                  file=sys.stderr)
+            elastic_ok = None
+        result["elastic_resume"] = elastic_ok
     if args.tuned:
         # tuned/cache columns (ISSUE 6): the winner being replayed and
         # whether this capture warm-started from the compile cache

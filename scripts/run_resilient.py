@@ -76,6 +76,32 @@ def load_resilience():
     return mod
 
 
+def _lost_goodput_estimate(bundles: Sequence[str]) -> Optional[dict]:
+    """Restart-cost estimate from the NEWEST bundle carrying the
+    preemption dump's accounting (ISSUE 14 satellite): the dying worker
+    stamps ``step_ema_s`` (host-wall EMA of one optimizer step) and
+    ``lost_steps_estimate`` (0 when the emergency save landed; steps since
+    the last durable save when it failed) into the bundle manifest — their
+    product prices the attempt's lost goodput in seconds without replaying
+    any JSONL.  None when no bundle carries the fields."""
+    for bundle in reversed(list(bundles)):
+        try:
+            with open(os.path.join(bundle, "manifest.json")) as f:
+                extra = (json.load(f) or {}).get("extra") or {}
+        except (OSError, ValueError):
+            continue
+        lost = extra.get("lost_steps_estimate")
+        ema = extra.get("step_ema_s")
+        if lost is None:
+            continue
+        out = {"lost_steps_estimate": int(lost)}
+        if ema is not None:
+            out["step_ema_s"] = round(float(ema), 6)
+            out["lost_goodput_s_est"] = round(int(lost) * float(ema), 3)
+        return out
+    return None
+
+
 def _fleet_verdict(bundles: Sequence[str]) -> Optional[dict]:
     """The fleet straggler verdict of the NEWEST bundle carrying one
     (ISSUE 5's fleet.json) — surfaces WHY the host died in the restart
@@ -140,7 +166,9 @@ def run_resilient(
             rz.RESTART_ATTEMPT_ENV: str(attempt),
             BUNDLE_FILE_ENV: bundle_file,
         }
+        t0 = time.monotonic()
         code = run(argv, attempt_env)
+        elapsed_s = time.monotonic() - t0
         bundles = _read_bundles(bundle_file)
         try:
             os.remove(bundle_file)
@@ -151,9 +179,15 @@ def run_resilient(
             "attempt": attempt,
             "exit_code": code,
             "class": classification,
+            # restart cost, readable straight off the record (ISSUE 14):
+            # attempt wall clock + the bundle-priced lost-goodput estimate
+            "elapsed_s": round(elapsed_s, 3),
             "bundles": bundles,
             "restarts_used": backoff.restarts_used,
         }
+        cost = _lost_goodput_estimate(bundles)
+        if cost is not None:
+            record.update(cost)
         verdict = _fleet_verdict(bundles)
         if verdict is not None:
             record["fleet_verdict"] = verdict

@@ -314,6 +314,67 @@ def main() -> int:
     rz_first.close_telemetry()
     rz_resumed.close_telemetry()
 
+    # elastic resilience (ISSUE 14): one OFFLOAD-STAGED async save →
+    # topology-elastic resume cycle — the save stages device→host off the
+    # step path (no main-thread gather) onto the 8-device mesh, and a
+    # 4-device run restores it bit-identically with the elastic counter
+    # ticking
+    import jax as _jax
+
+    from stoke_tpu import CheckpointConfig, MeshConfig
+
+    el_root = os.path.join(out_dir, "elastic")
+    el_ckpt = CheckpointConfig(async_save=True, offload_staging=True)
+
+    def _el_run(mesh_cfg=None):
+        cfgs = [
+            el_ckpt,
+            ResilienceConfig(
+                save_path=el_root, exit_on_preempt=False
+            ),
+        ]
+        if mesh_cfg is not None:
+            cfgs.append(mesh_cfg)
+        return Stoke(
+            model=lambda p, x: x @ p["w"],
+            optimizer=StokeOptimizer(
+                optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.1}
+            ),
+            loss=lambda o, y: ((o - y) ** 2).mean(),
+            params={"w": np.full((8, 4), 2.0, np.float32)},
+            batch_size_per_device=2,
+            distributed="dp",
+            configs=cfgs,
+            verbose=False,
+        )
+
+    el_first = _el_run()
+    el_first.train_step(x, (y,))
+    el_first._save_with_config(el_root, "emergency", el_ckpt, None)
+    el_first.wait_for_checkpoint()
+    el_params = np.asarray(el_first.params["w"])
+    el_half = _el_run(
+        MeshConfig(devices=np.array(_jax.devices("cpu")[:4]))
+    )
+    el_resumed = el_half.resume()
+    el_sum = el_half.resilience_summary or {}
+    elastic_ok = (
+        el_resumed
+        and int(el_first._mesh.size) == 8
+        and int(el_half._mesh.size) == 4
+        and np.array_equal(np.asarray(el_half.params["w"]), el_params)
+        and el_sum.get("elastic_resumes") == 1
+        and os.path.exists(
+            os.path.join(
+                el_root,
+                "stoke-emergency-backward-step-1",
+                "variables.staged.rank0.npz",
+            )
+        )
+    )
+    el_first.close_telemetry()
+    el_half.close_telemetry()
+
     # sharded quantized transport (ISSUE 8): one optimizer step through
     # the weight-update-sharded path — int8 reduce-scatter + per-shard
     # error feedback under sddp — with the JSONL recording BOTH wire legs
@@ -547,6 +608,7 @@ def main() -> int:
         and {"sentinels", "step_event"} <= ring_kinds
         and compile_cache_ok
         and resilience_ok
+        and elastic_ok
         and zero_ok
         and serving_ok
         and tracing_ok
@@ -579,6 +641,8 @@ def main() -> int:
         "compile_cache_warm": cc_warm.compile_cache.stats(),
         "resilience_cycle": "ok" if resilience_ok else "FAILED",
         "resilience_resumed": rz_resumed.resilience_summary,
+        "elastic_cycle": "ok" if elastic_ok else "FAILED",
+        "elastic_resumed": el_sum.get("elastic_resumes"),
         "zero_sharded_step": "ok" if zero_ok else "FAILED",
         "zero_comm_compression": zero_rec.get("comm_compression"),
         "zero_param_gather_bytes": zero_rec.get("comm_bytes_param_gather"),

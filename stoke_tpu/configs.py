@@ -595,6 +595,18 @@ class CheckpointConfig:
     count, so a config written for a larger pod degrades safely.  Sharded
     saves always write from every process; ``save_rank`` then only selects
     the metadata writer.
+
+    ``offload_staging`` (ISSUE 14, requires ``async_save`` and the
+    consolidated format — status-validated): zero-stall periodic saves.
+    Instead of completing a blocking device→host gather on the main thread
+    before the background writer takes over, the save stages the state
+    through ``stoke_tpu.offload.StagedSnapshot`` — one compiled-copy
+    dispatch on the step path, async host transfers off it, at most two
+    snapshots in flight (double buffering) — and every process writes its
+    own ``<key>.staged.rank<N>.npz`` shard files against normalized global
+    indices, which also makes the on-disk layout topology-free (loadable
+    onto any mesh; the elastic-resume substrate).  The emergency
+    preemption save keeps its carefully-sequenced synchronous gather.
     """
 
     format: CheckpointFormat = CheckpointFormat.consolidated
@@ -604,6 +616,7 @@ class CheckpointConfig:
     auto_path: Optional[str] = None
     auto_name: str = "auto"
     save_rank: int = 0
+    offload_staging: bool = False
 
 
 # --------------------------------------------------------------------------- #
@@ -989,6 +1002,27 @@ class FleetConfig:
             bundle; requires a ``HealthConfig`` whose recorder writes
             it, otherwise degrades to warn).  Validated against
             ``FLEET_ACTIONS``.
+        rebalance: skew-reactive input rebalancing (ISSUE 14 tentpole c;
+            default OFF — off keeps the step programs, loader behavior,
+            and JSONL schema byte-identical, zero new fields).  When a
+            straggler streak completes (the SAME K-window hysteresis that
+            fires the ``fleet_straggler`` detector) with skew class
+            ``loader``, the fleet shifts ``rebalance_rows`` samples of
+            per-slice READ work from the flagged host to the host with the
+            least loader wait.  The global batch, per-epoch sample set,
+            and every host's device feed are unchanged — only which host
+            reads (and decodes) which rows moves; the surplus rows ride
+            one host-side allgather back to their canonical host.
+            Requires loaders built from ``Stoke.DataLoader`` with a
+            sampler exposing ``global_batches()``
+            (``BucketedDistributedSampler``).  Surfaced as
+            ``fleet/rebalance_*`` gauges and JSONL fields.
+        rebalance_rows: samples moved per actuation (>= 1; the bounded
+            step size).
+        rebalance_max_frac: ceiling on any host's share deviation from
+            the equal split, as a fraction of the per-host batch
+            (0 < f < 1) — a persistently slow host sheds at most this
+            much of its read work, never all of it.
     """
 
     window_steps: int = 10
@@ -996,6 +1030,9 @@ class FleetConfig:
     straggler_rel_frac: float = 0.25
     straggler_windows: int = 3
     straggler_action: str = "warn"
+    rebalance: bool = False
+    rebalance_rows: int = 1
+    rebalance_max_frac: float = 0.25
 
 
 @dataclass

@@ -178,6 +178,271 @@ class _NativeRaggedLoader(_NativeLoaderBase):
 
 
 # --------------------------------------------------------------------------- #
+# Skew-reactive input rebalancing (ISSUE 14 tentpole c)
+# --------------------------------------------------------------------------- #
+#
+# The fleet monitor (PR 5) can NAME the host whose input pipeline drags the
+# pod; this layer is what finally acts on it.  Each global batch ("slice",
+# batch_size × num_replicas rows) has a canonical per-host split — host r
+# feeds rows [r·B, (r+1)·B) of the canonical order to its devices.  The
+# rebalancer moves the READ work instead: host r reads a contiguous
+# ``shares[r]``-row range of the canonical slice (shares sum to the slice,
+# equal shares ≡ today's behavior: every host reads exactly its own rows
+# and no collective runs).  When shares are shifted, the surplus rows ride
+# ONE host-side allgather back to their canonical host — so the global
+# batch, the per-epoch sample set, and every host's device feed are
+# unchanged by construction; only who pays the disk/decode cost moves.
+#
+# Fleet-wide agreement without extra collectives: share updates are
+# computed on the IDENTICAL exchanged fleet matrix on every host (the
+# monitor's actuation is deterministic), and take effect at a future fetch
+# index no host can have reached yet (yields are lockstep across SPMD
+# hosts; fetches lead yields by at most the prefetch depth, so
+# ``yields + apply_slack`` with slack > prefetch is a safe apply point).
+
+
+class InputRebalancer:
+    """Per-host read-share state + the deterministic apply protocol.
+
+    ``shares[r]`` is how many rows of each canonical slice host ``r``
+    reads; all hosts hold identical copies and evolve them identically.
+    ``propose_shift`` (called by the fleet monitor at straggler-streak
+    boundaries) schedules a bounded share move that becomes effective at a
+    fetch index strictly ahead of every host's loader; the loader calls
+    ``shares_for_fetch`` once per batch fetch and ``note_yield`` once per
+    delivered batch.
+    """
+
+    def __init__(
+        self,
+        n_hosts: int,
+        rank: int,
+        batch_size: int,
+        max_frac: float = 0.25,
+        apply_slack: int = 4,
+    ):
+        if not (0 <= rank < max(n_hosts, 1)):
+            raise ValueError(
+                f"Stoke -- rebalancer rank {rank} out of range for "
+                f"{n_hosts} hosts"
+            )
+        self.n_hosts = max(int(n_hosts), 1)
+        self.rank = int(rank)
+        self.batch_size = int(batch_size)
+        #: hard bound: no host's share may leave
+        #: [batch - max_shift, batch + max_shift]
+        self.max_shift = int(float(max_frac) * self.batch_size)
+        if self.max_shift < 1:
+            # a bound that truncated to zero is a permanently-dead
+            # actuator — the silently-ignored-knob anti-pattern the status
+            # rules exist to prevent; loud, never a silent no-op
+            raise ValueError(
+                f"Stoke -- rebalance_max_frac={max_frac} of per-host "
+                f"batch {self.batch_size} rounds to a zero-row share "
+                f"bound; the actuator could never move work. Raise "
+                f"rebalance_max_frac or the per-host batch, or drop "
+                f"rebalance"
+            )
+        self.apply_slack = max(int(apply_slack), 1)
+        self.shares: List[int] = [self.batch_size] * self.n_hosts
+        self._pending: List[Any] = []  # (effective_fetch, shares) FIFO
+        self._fetches = 0
+        self._yields = 0
+        self.shifts = 0
+        self.rows_moved = 0
+
+    def share_of(self, host: int) -> int:
+        """The latest scheduled share of ``host`` (pending updates
+        included — the value gauges/JSONL report)."""
+        target = self._pending[-1][1] if self._pending else self.shares
+        return int(target[host])
+
+    @property
+    def shifted(self) -> bool:
+        target = self._pending[-1][1] if self._pending else self.shares
+        return len(set(target)) > 1
+
+    def note_yield(self) -> None:
+        """One batch delivered to the training loop (lockstep across
+        hosts — the apply-point anchor)."""
+        self._yields += 1
+
+    def propose_shift(self, from_host: int, to_host: int, rows: int) -> int:
+        """Schedule moving ``rows`` of read work ``from_host → to_host``,
+        clamped to the per-host bound; returns the rows actually moved
+        (0 when the bound already binds).  Deterministic given identical
+        call sequences — the fleet-wide agreement contract."""
+        if from_host == to_host or rows <= 0:
+            return 0
+        base = list(self._pending[-1][1]) if self._pending else list(
+            self.shares
+        )
+        lo = self.batch_size - self.max_shift
+        hi = self.batch_size + self.max_shift
+        rows = int(min(rows, base[from_host] - lo, hi - base[to_host]))
+        if rows <= 0:
+            return 0
+        base[from_host] -= rows
+        base[to_host] += rows
+        eff = self._yields + self.apply_slack
+        if self._pending:
+            eff = max(eff, self._pending[-1][0])
+        self._pending.append((eff, base))
+        self.shifts += 1
+        self.rows_moved += rows
+        return rows
+
+    def shares_for_fetch(self) -> List[int]:
+        """The share vector governing the NEXT fetched batch; advances the
+        fetch counter and applies any update whose effective index has
+        arrived.  Every host calls this once per batch in the same order,
+        so fetch ``f`` sees the same shares fleet-wide."""
+        f = self._fetches
+        self._fetches += 1
+        while self._pending and self._pending[0][0] <= f:
+            self.shares = list(self._pending.pop(0)[1])
+        return list(self.shares)
+
+
+def _tree_map_arrays(fn, tree):
+    """Map ``fn`` over the array leaves of a batch pytree (jax's tree_map,
+    imported lazily — this module stays importable without touching a
+    backend; covers every container collate functions produce)."""
+    import jax
+
+    return jax.tree_util.tree_map(fn, tree)
+
+
+def _pad_rows(tree, n: int):
+    """Zero-pad every leaf's leading (row) axis to exactly ``n`` — the
+    fixed-shape payload the exchange collective needs."""
+
+    def leaf(x):
+        x = np.asarray(x)
+        if x.shape[0] == n:
+            return x
+        pad = np.zeros((n - x.shape[0],) + x.shape[1:], x.dtype)
+        return np.concatenate([x, pad], axis=0)
+
+    return _tree_map_arrays(leaf, tree)
+
+
+def _default_allgather(tree):
+    """Cross-host exchange of the padded read payload: every leaf gains a
+    leading ``[n_hosts]`` axis.  Only invoked while shares are actually
+    shifted — a balanced fleet reads its own rows and never collects."""
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(tree)
+    return _tree_map_arrays(np.asarray, gathered)
+
+
+def reassemble_from_gathered(gathered, shares, rank: int, batch_size: int):
+    """Pick this host's canonical batch rows out of the gathered per-host
+    read payloads.  Canonical row ``j`` was read by the host whose share
+    range covers ``j``; the math is pure so the mp harness and the
+    simulated-host unit tests exercise the SAME code."""
+    cuts = np.concatenate([[0], np.cumsum(np.asarray(shares, np.int64))])
+    j = np.arange(rank * batch_size, (rank + 1) * batch_size)
+    host_of = np.searchsorted(cuts, j, side="right") - 1
+    off = j - cuts[host_of]
+
+    def leaf(x):
+        x = np.asarray(x)  # [n_hosts, slice_size, ...]
+        return x[host_of, off]
+
+    return _tree_map_arrays(leaf, gathered)
+
+
+def assemble_rebalanced_batch(
+    per_replica, shares, rank: int, batch_size: int, assemble, allgather=None
+):
+    """One rebalanced batch: read this host's share of the canonical
+    slice, exchange only when shares are shifted, return this host's
+    canonical batch.  ``per_replica`` is the sampler's per-host index plan
+    for one batch (``BucketedDistributedSampler.global_batches()`` entry);
+    ``assemble(idx)`` reads + collates rows; ``allgather`` is injectable
+    so single-process tests can simulate a fleet."""
+    canonical = [i for sub in per_replica for i in sub]
+    cuts = np.concatenate([[0], np.cumsum(np.asarray(shares, np.int64))])
+    if int(cuts[-1]) != len(canonical):
+        raise ValueError(
+            f"Stoke -- rebalance shares {list(shares)} do not cover the "
+            f"slice ({len(canonical)} rows)"
+        )
+    mine = canonical[int(cuts[rank]):int(cuts[rank + 1])]
+    rows = assemble(mine)
+    if max(shares) == min(shares):
+        # balanced: this host read exactly its canonical batch — no
+        # exchange, byte-identical to the non-rebalanced loader's output
+        return rows
+    # pad to the LARGEST share, not the whole slice: shares are identical
+    # fleet-wide (the deterministic agreement protocol), so max(shares)
+    # is a valid uniform collective shape at a fraction of the bytes —
+    # reassembly only ever indexes off < shares[host]
+    payload = _pad_rows(rows, int(max(shares)))
+    gather = allgather if allgather is not None else _default_allgather
+    return reassemble_from_gathered(
+        gather(payload), shares, rank, batch_size
+    )
+
+
+class _RebalancedLoader:
+    """Inner loader for the rebalanced read path: walks the sampler's
+    GLOBAL batch plan, reads this host's share of each slice, and yields
+    this host's canonical (host-side) batches.  Wrapped by
+    :class:`StokeDataLoader` like any other inner loader, so placement,
+    telemetry wait accounting, and prefetch are unchanged."""
+
+    def __init__(
+        self,
+        dataset,
+        sampler,
+        batch_size: int,
+        rebalancer: InputRebalancer,
+        collate_fn=None,
+        allgather=None,
+    ):
+        self.dataset = dataset
+        self.sampler = sampler
+        self.batch_size = int(batch_size)
+        self.rebalancer = rebalancer
+        self._collate = collate_fn or _default_collate
+        self._allgather = allgather
+        self._batcher = None
+        if isinstance(dataset, ArrayDataset):
+            from stoke_tpu.native import NativeBatcher
+
+            self._batcher = NativeBatcher()
+
+    def __len__(self):
+        return len(self.sampler) // self.batch_size
+
+    def _assemble(self, idx):
+        if self._batcher is not None:
+            gathered = np.asarray(idx, np.int64)
+            batch = tuple(
+                self._batcher.gather_rows(a, gathered)
+                for a in self.dataset.arrays
+            )
+            return batch if len(batch) > 1 else batch[0]
+        return self._collate([self.dataset[int(i)] for i in idx])
+
+    def __iter__(self):
+        rb = self.rebalancer
+        for per_replica in self.sampler.global_batches():
+            shares = rb.shares_for_fetch()
+            yield assemble_rebalanced_batch(
+                per_replica,
+                shares,
+                rb.rank,
+                self.batch_size,
+                self._assemble,
+                self._allgather,
+            )
+
+
+# --------------------------------------------------------------------------- #
 # Loader
 # --------------------------------------------------------------------------- #
 
@@ -338,12 +603,46 @@ class StokeDataLoader:
         prefetch: int = 2,
         place: bool = True,
         telemetry=None,
+        rebalancer: Optional[InputRebalancer] = None,
+        rebalance_allgather=None,
         **kwargs,
     ):
         self._place_fn = place_fn if place else None
         self._prefetch = max(int(prefetch), 1)
         self._telemetry = telemetry
         self.batch_size = batch_size
+        self._rebalancer = rebalancer
+        if rebalancer is not None:
+            # skew-reactive read rebalancing (ISSUE 14): needs the GLOBAL
+            # batch plan, so the sampler must expose it
+            sampler = kwargs.get("sampler")
+            if sampler is None or not hasattr(sampler, "global_batches"):
+                raise ValueError(
+                    "Stoke -- input rebalancing (FleetConfig.rebalance) "
+                    "requires a sampler exposing global_batches() — use "
+                    "BucketedDistributedSampler (or drop rebalance)"
+                )
+            unconsumed = set(kwargs) - {"sampler", "collate_fn"}
+            if unconsumed:
+                # the rebalanced read path assembles rows itself — a
+                # num_workers/drop_last/... silently ignored here would
+                # change read semantics without a diagnostic (the
+                # silently-ignored-knob anti-pattern)
+                raise ValueError(
+                    f"Stoke -- the rebalanced loader path consumes only "
+                    f"sampler/collate_fn; {sorted(unconsumed)} would be "
+                    f"silently ignored — drop them or turn off "
+                    f"FleetConfig.rebalance"
+                )
+            self._loader = _RebalancedLoader(
+                dataset,
+                sampler,
+                batch_size,
+                rebalancer,
+                collate_fn=kwargs.get("collate_fn"),
+                allgather=rebalance_allgather,
+            )
+            return
         if isinstance(dataset, ArrayDataset):
             # native fast path: one GIL-free row-gather per array per batch
             self._loader = _NativeArrayLoader(dataset, batch_size=batch_size, **kwargs)
@@ -437,6 +736,7 @@ class StokeDataLoader:
                 except StopIteration:
                     return
                 warm = True
+                self._note_yield()
                 yield batch
             return
         # lookahead pipeline: keep `prefetch` placed batches in flight
@@ -453,7 +753,15 @@ class StokeDataLoader:
                 queue.append(self._place_fn(fetch(it, warm=True)))
             except StopIteration:
                 pass
+            self._note_yield()
             yield out
+
+    def _note_yield(self) -> None:
+        # rebalancer apply-point anchor (ISSUE 14): delivered-batch counts
+        # are lockstep across SPMD hosts, unlike fetch counts, which lead
+        # by up to the prefetch depth
+        if self._rebalancer is not None:
+            self._rebalancer.note_yield()
 
 
 class _NumpySafeTorchCollate:
@@ -645,7 +953,13 @@ class BucketedDistributedSampler:
     def _epoch_rng(self) -> np.random.Generator:
         return np.random.default_rng(self.seed + self.epoch)
 
-    def __iter__(self) -> Iterator[int]:
+    def _epoch_slices(self) -> List[List[int]]:
+        """This epoch's global slices (``slice_size`` indices each), in
+        final yielded order — the rng call sequence (per-bucket shuffles,
+        then the cross-bucket batch-order shuffle) is byte-identical to
+        the pre-refactor ``__iter__``, so per-epoch streams are unchanged.
+        Shared by ``__iter__`` (this replica's strided sub-batches) and
+        ``global_batches`` (every replica's — the rebalanced read path)."""
         rng = self._epoch_rng()
         if self.shuffle:
             buckets = [list(np.asarray(b)[rng.permutation(len(b))]) for b in self.bucket_idx]
@@ -657,13 +971,12 @@ class BucketedDistributedSampler:
                 padded = self._pad_bucket(b)
                 assert len(padded) == self.rounded_num_samples_per_bucket
                 buckets[i] = padded
-        # carve into slices; each replica takes its strided sub-batch
-        batches: List[List[int]] = []
+        # carve into slices
+        slices: List[List[int]] = []
         for b in buckets:
             for s in range(self.num_slices_per_bucket):
-                sl = b[s * self.slice_size : (s + 1) * self.slice_size]
-                batches.append(sl[self.rank : self.slice_size : self.num_replicas])
-        # regroup dropped residuals into extra mixed batches
+                slices.append(b[s * self.slice_size : (s + 1) * self.slice_size])
+        # regroup dropped residuals into extra mixed slices
         if self.drop_last and self.allow_bucket_overlap:
             residual = list(
                 itertools.chain(
@@ -671,11 +984,30 @@ class BucketedDistributedSampler:
                 )
             )
             for s in range(len(residual) // self.slice_size):
-                sl = residual[s * self.slice_size : (s + 1) * self.slice_size]
-                batches.append(sl[self.rank : self.slice_size : self.num_replicas])
+                slices.append(residual[s * self.slice_size : (s + 1) * self.slice_size])
         if self.shuffle:
-            order = rng.permutation(len(batches))
-            batches = [batches[i] for i in order]
+            order = rng.permutation(len(slices))
+            slices = [slices[i] for i in order]
+        return slices
+
+    def _replica_batch(self, sl: List[int], rank: int) -> List[int]:
+        return sl[rank : self.slice_size : self.num_replicas]
+
+    def global_batches(self) -> List[List[List[int]]]:
+        """EVERY replica's read plan for this epoch (ISSUE 14, the
+        rebalanced loader's input): one entry per yielded batch, each a
+        ``num_replicas``-list of canonical per-replica index lists.  Entry
+        ``b[rank]`` equals batch ``b`` of this epoch's ``__iter__``
+        stream for that rank — all replicas derive the identical plan."""
+        return [
+            [self._replica_batch(sl, r) for r in range(self.num_replicas)]
+            for sl in self._epoch_slices()
+        ]
+
+    def __iter__(self) -> Iterator[int]:
+        batches = [
+            self._replica_batch(sl, self.rank) for sl in self._epoch_slices()
+        ]
         flat = [int(i) for i in itertools.chain(*batches)]
         assert len(flat) == self.rounded_num_samples_per_replica
         return iter(flat)

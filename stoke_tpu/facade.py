@@ -24,6 +24,7 @@ the collective schedule.
 
 from __future__ import annotations
 
+import functools
 import os
 import time
 from typing import Any, Callable, Dict, Optional, Sequence, Union
@@ -413,6 +414,13 @@ class Stoke:
         self._ema_weight = float(ema_weight)
         self._skipped_steps = self._zero_scalar()
         self._last_step_loss = None
+        # restart-cost accounting (ISSUE 14 satellite): the step of the
+        # last durable save and a host-wall EMA of one optimizer step —
+        # the preemption bundle carries both so the supervisor can price
+        # an attempt's lost goodput without replaying JSONL
+        self._last_save_step = 0
+        self._step_wall_ema: Optional[float] = None
+        self._last_boundary_t: Optional[float] = None
 
         # ----- lazy-step bookkeeping -----
         self._training = True
@@ -1656,6 +1664,12 @@ class Stoke:
 
         io_ops.wait_for_saves()
 
+    def _note_durable_save(self, step: int) -> None:
+        """One checkpoint's write fully landed (io_ops ``on_durable``,
+        possibly from a background thread — a GIL-atomic max-update).
+        The lost-goodput estimate prices steps beyond THIS point."""
+        self._last_save_step = max(self._last_save_step, int(step))
+
     def maybe_resume(self, path: Optional[str] = None) -> bool:
         """Resume from the newest auto-checkpoint if one exists; otherwise
         start fresh.  Returns True when a checkpoint was loaded.  Combined
@@ -1697,6 +1711,89 @@ class Stoke:
             return None
         return self._resilience.summary()
 
+    def topology_descriptor(self) -> Dict[str, Any]:
+        """This run's topology/sharding descriptor (ISSUE 14): mesh shape,
+        process count, sharding tier, the resolved ``shard_updates``, and
+        the gradient transport's state-layout (per-bucket padding is
+        world-size-dependent — the ZeRO partition algebra elastic resume
+        re-maps through).  Embedded in every manifest this facade writes;
+        compared against a checkpoint's saved descriptor at resume."""
+        from stoke_tpu.configs import comm_shard_updates
+
+        st = self._status_obj
+        mesh = self._mesh
+        params = self._variables["params"]
+        leaves = jax.tree_util.tree_leaves(params)
+        comm = None
+        transport = getattr(self._engine, "transport", None)
+        if transport is not None:
+            comm = transport.layout_descriptor(params)
+        return {
+            "version": 1,
+            "process_count": int(jax.process_count()),
+            "device_count": int(mesh.size) if mesh is not None else 1,
+            "mesh_axes": (
+                list(mesh.axis_names) if mesh is not None else None
+            ),
+            "mesh_shape": (
+                [int(mesh.shape[a]) for a in mesh.axis_names]
+                if mesh is not None
+                else None
+            ),
+            "tier": st.sharding_tier.value,
+            "shard_updates": bool(
+                comm_shard_updates(st.comm_config, st.sharding_tier)
+            ),
+            "axis_name": (
+                self._rules.axis_name if self._rules is not None else None
+            ),
+            "param_leaves": len(leaves),
+            "param_elems": int(
+                sum(
+                    int(np.prod(l.shape)) if l.shape else 1 for l in leaves
+                )
+            ),
+            "comm": comm,
+        }
+
+    def _descriptor_incompatible(
+        self, saved: Optional[Dict[str, Any]]
+    ) -> Optional[str]:
+        """Why a saved topology descriptor CANNOT serve this run (None =
+        compatible; topology differences are fine — that is what elastic
+        resume re-shards across).  Genuinely incompatible means the state
+        itself cannot re-map: a different parameter tree.  The returned
+        reason names the remedy (the quarantine record an operator reads)."""
+        if not saved:
+            return None  # legacy manifest without a descriptor
+        cur = self.topology_descriptor()
+        for key in ("param_elems", "param_leaves"):
+            if key in saved and saved[key] != cur[key]:
+                return (
+                    f"incompatible checkpoint: saved {key}={saved[key]} "
+                    f"vs current {key}={cur[key]} — the checkpoint was "
+                    f"written by a different MODEL; resume with the "
+                    f"saving architecture, or point resume() at this "
+                    f"run's own checkpoint root"
+                )
+        return None
+
+    @staticmethod
+    def _topology_changed(
+        saved: Optional[Dict[str, Any]], cur: Dict[str, Any]
+    ) -> bool:
+        """Did the fleet change shape between save and resume?  (The
+        ``resilience/elastic_resumes`` accounting predicate.)"""
+        if not saved:
+            return False
+        return any(
+            saved.get(k) != cur.get(k)
+            for k in (
+                "mesh_shape", "process_count", "device_count", "tier",
+                "shard_updates",
+            )
+        )
+
     def resume(self, path: Optional[str] = None, name: str = "stoke") -> bool:
         """Restore the newest VALID checkpoint and the step counters; the
         auto-resume half of preemption survival (ISSUE 7).
@@ -1725,6 +1822,7 @@ class Stoke:
         from stoke_tpu.resilience import (
             find_latest_valid_checkpoint,
             list_checkpoints,
+            read_manifest,
         )
 
         mon = self._resilience
@@ -1751,6 +1849,23 @@ class Stoke:
         )
         verify = mon.cfg.verify_on_resume if mon is not None else True
         quarantine = mon.cfg.quarantine if mon is not None else False
+
+        manifest_cache: Dict[str, Any] = {}
+
+        def _validate_descriptor(tag_dir):
+            """Post-digest candidate check (ISSUE 14): a checkpoint whose
+            topology descriptor cannot serve this run is quarantined with
+            the remedy named, never crash-restored.  Topology DIFFERENCES
+            pass — re-sharding them is elastic resume's whole point.  The
+            parsed manifest is cached so the elastic-resume decision below
+            reads the SAME descriptor that passed validation."""
+            manifest = read_manifest(tag_dir)
+            manifest_cache[tag_dir] = manifest
+            topo = (manifest or {}).get("topology")
+            reason = self._descriptor_incompatible(topo)
+            if reason is not None:
+                return False, reason
+            return True, "ok"
 
         def _on_quarantine(tag_dir, dest, reason):
             self.warn(
@@ -1780,6 +1895,7 @@ class Stoke:
                     verify=verify,
                     quarantine=quarantine,
                     on_quarantine=_on_quarantine,
+                    validate_fn=_validate_descriptor,
                 )
                 if cand is not None:
                     pick = np.array(
@@ -1814,9 +1930,15 @@ class Stoke:
                 verify=verify,
                 quarantine=quarantine,
                 on_quarantine=_on_quarantine,
+                validate_fn=_validate_descriptor,
             )
         if cand is None:
             return False
+        manifest = manifest_cache.get(cand["tag_dir"])
+        if manifest is None:
+            # multi-host non-validating path (rank 0 validated + broadcast)
+            manifest = read_manifest(cand["tag_dir"])
+        saved_topo = (manifest or {}).get("topology")
         extras = self.load(cand["root"], tag=cand["tag"])
         rs = extras.get("resilience") if isinstance(extras, dict) else None
         if rs:
@@ -1830,6 +1952,19 @@ class Stoke:
                     self._status_obj.grad_accum, 1
                 )
             mon.note_resumed(self._optimizer_steps, lost_steps=lost)
+            cur_topo = self.topology_descriptor()
+            if self._topology_changed(saved_topo, cur_topo):
+                # topology-elastic resume (ISSUE 14): the fleet that
+                # resumed is NOT the fleet that saved — params/opt/EF
+                # state were re-sharded onto the new layout at load
+                mon.note_elastic_resume(saved_topo, cur_topo)
+                self.info(
+                    f"elastic resume: checkpoint saved on mesh "
+                    f"{(saved_topo or {}).get('mesh_shape')} "
+                    f"(tier {(saved_topo or {}).get('tier')}), resumed "
+                    f"onto {cur_topo.get('mesh_shape')} "
+                    f"(tier {cur_topo.get('tier')})"
+                )
         self.info(
             f"resumed from {cand['tag_dir']} at optimizer step "
             f"{self._optimizer_steps}"
@@ -1845,6 +1980,18 @@ class Stoke:
         mon = self._resilience
         if mon is None:
             return
+        # host-wall EMA of one optimizer step (resilience-on only; two
+        # perf_counter reads per boundary): the preemption bundle's
+        # lost-goodput price basis
+        now = time.perf_counter()
+        if self._last_boundary_t is not None and window > 0:
+            per_step = (now - self._last_boundary_t) / max(window, 1)
+            self._step_wall_ema = (
+                per_step
+                if self._step_wall_ema is None
+                else 0.7 * self._step_wall_ema + 0.3 * per_step
+            )
+        self._last_boundary_t = now
         mon.chaos.on_step(self._optimizer_steps, window)
         preempt = mon.preempt_requested
         if jax.process_count() > 1:
@@ -1892,7 +2039,11 @@ class Stoke:
         if self._health is not None:
             # the post-mortem bundle rides along (fleet verdict included):
             # the restart record shows WHY this host died, not just that
-            # it did
+            # it did.  step_ema_s + lost_steps_estimate (ISSUE 14
+            # satellite) let the supervisor price the attempt's lost
+            # goodput straight from the bundle manifest: 0 lost when the
+            # emergency save landed, steps-since-last-durable-save when
+            # it failed.
             try:
                 self._health.dump(
                     "preemption",
@@ -1900,6 +2051,12 @@ class Stoke:
                         "step": step,
                         "signal": mon.preempt_signal,
                         "emergency_tag": tag_dir,
+                        "step_ema_s": self._step_wall_ema,
+                        "lost_steps_estimate": (
+                            0
+                            if tag_dir is not None
+                            else max(0, step - self._last_save_step)
+                        ),
                     },
                 )
             except Exception:
@@ -1922,11 +2079,12 @@ class Stoke:
         state (rng / loss EMA / EF residual / counters)."""
         import dataclasses as _dc
 
-        from stoke_tpu import io_ops
-
         mon = self._resilience
         try:
-            io_ops.wait_for_saves()
+            # facade drain (not bare wait_for_saves): a successful drain
+            # also promotes the pending async save into the durable
+            # lost-goodput accounting
+            self.wait_for_checkpoint()
         except RuntimeError as e:
             # failed EARLIER async saves must not block the emergency save
             self.warn(f"async checkpoint drain reported failures: {e}")
@@ -1968,6 +2126,12 @@ class Stoke:
             from stoke_tpu.io_ops import _gather_to_host
 
             state["comm_state"] = _gather_to_host(self._comm_state)
+            # layout descriptor (ISSUE 14): the key that lets a resume on
+            # a DIFFERENT topology re-partition the residual instead of
+            # dropping it — bucket padding is world-size-dependent
+            state["comm_layout"] = self._engine.transport.layout_descriptor(
+                self._variables["params"]
+            )
         return state
 
     def _restore_resume_state(self, rs: Dict[str, Any]) -> None:
@@ -1985,6 +2149,39 @@ class Stoke:
                 )
             host_comm = rs.get("comm_state")
             if host_comm and self._comm_state:
+                saved_desc = rs.get("comm_layout")
+                cur_desc = self._engine.transport.layout_descriptor(
+                    self._variables["params"]
+                )
+                if (
+                    saved_desc
+                    and cur_desc
+                    and "residual" in host_comm
+                    and "residual" in self._comm_state
+                    and (
+                        saved_desc["kind"] != cur_desc["kind"]
+                        or saved_desc["buckets"] != cur_desc["buckets"]
+                        or saved_desc["world"] != cur_desc["world"]
+                    )
+                ):
+                    # topology-elastic residual re-map (ISSUE 14): the
+                    # saved layout (bucket padding, sharded vs replicated
+                    # packing) differs from this run's — unpack to the
+                    # flat per-element vector under the SAVED descriptor,
+                    # repack under the CURRENT one (zero.py partition
+                    # algebra), then place as usual below
+                    from stoke_tpu.parallel.zero import remap_residual
+
+                    host_comm = {
+                        **host_comm,
+                        "residual": remap_residual(
+                            host_comm["residual"],
+                            saved_desc,
+                            cur_desc,
+                            self._comm_state["residual"],
+                        ),
+                    }
+
                 def _leaf(cur, new):
                     if isinstance(cur, jax.Array):
                         arr = np.asarray(new)
@@ -2640,6 +2837,32 @@ class Stoke:
                 "(see BucketedDistributedSampler / DistributedSampler) — "
                 "reference stoke.py:822-826"
             )
+        fcfg = self._status_obj.fleet_config
+        if (
+            "rebalancer" not in kwargs
+            and fcfg is not None
+            and getattr(fcfg, "rebalance", False)
+            and self._fleet is not None
+            and jax.process_count() > 1
+        ):
+            # skew-reactive input rebalancing (ISSUE 14): build the
+            # actuator and hand it to both sides — the fleet monitor
+            # proposes bounded share shifts at straggler-streak
+            # boundaries, the loader applies them at an agreed future
+            # fetch index.  Single-process runs skip it entirely (a fleet
+            # of one has nothing to rebalance; behavior is untouched).
+            from stoke_tpu.data import InputRebalancer
+
+            rb = InputRebalancer(
+                n_hosts=jax.process_count(),
+                rank=jax.process_index(),
+                batch_size=batch_size,
+                max_frac=fcfg.rebalance_max_frac,
+                # apply strictly past every host's prefetch lookahead
+                apply_slack=int(kwargs.get("prefetch", 2)) + 2,
+            )
+            self._fleet.attach_rebalancer(rb)
+            kwargs["rebalancer"] = rb
         return StokeDataLoader(
             dataset,
             batch_size=batch_size,
@@ -2769,6 +2992,7 @@ class Stoke:
             k: v for k, v in self._variables.items() if k != "losses"
         }
         mon = self._resilience
+        with_manifest = mon is not None and mon.cfg.manifest
         with trace_span("stoke/io", track="io"):
             tag_dir = io_ops.save_checkpoint(
                 path=path,
@@ -2791,7 +3015,27 @@ class Stoke:
                 # integrity manifests (ISSUE 7): every checkpoint this
                 # facade writes under a ResilienceConfig carries per-file
                 # digests — the record resume() validates before trusting
-                manifest=(mon is not None and mon.cfg.manifest),
+                manifest=with_manifest,
+                # topology/sharding descriptor (ISSUE 14): what elastic
+                # resume re-shards against — rides the manifest
+                topology=(
+                    self.topology_descriptor() if with_manifest else None
+                ),
+                # kill_during_save injector hook (ISSUE 14 satellite)
+                chaos=(
+                    mon.chaos
+                    if mon is not None and mon.chaos.active
+                    else None
+                ),
+                # restart-cost accounting (ISSUE 14 satellite): each save
+                # promotes ITS OWN step into "last durable save" only when
+                # its write fully lands — sync saves on return, async ones
+                # from the background thread after meta.json.  Per-save,
+                # so an older save that completed stays counted even when
+                # a newer one is still in flight or fails.
+                on_durable=functools.partial(
+                    self._note_durable_save, self._optimizer_steps
+                ),
             )
         if mon is not None and mon.chaos.active:
             # corrupt_save injection (the quarantine path's deterministic

@@ -30,7 +30,7 @@ import pickle
 import re
 import shutil
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 _ASYNC_SAVES: list = []  # in-flight background save threads
 _ASYNC_ERRORS: list = []  # exceptions raised by background saves (surfaced in wait_for_saves)
@@ -122,6 +122,136 @@ def _load_consolidated(tag_dir: str, key: str, like: Any) -> Any:
     return jax.tree_util.tree_unflatten(treedef, placed)
 
 
+def _np_dtype(name: str) -> np.dtype:
+    """Dtype from its string name, including the ml_dtypes family numpy
+    itself cannot resolve (bfloat16, fp8 variants) — those are looked up on
+    the jax.numpy namespace."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import jax.numpy as jnp
+
+        return np.dtype(getattr(jnp, name))
+
+
+def _staged_files(key: str, rank: int) -> Tuple[str, str]:
+    """(npz, json) file names of one process's staged payload of ``key``."""
+    return (
+        f"{key}.staged.rank{rank}.npz",
+        f"{key}.staged.rank{rank}.json",
+    )
+
+
+def _write_staged_payload(
+    tag_dir: str, key: str, rank: int, records: list
+) -> None:
+    """Write one resolved :class:`~stoke_tpu.offload.StagedSnapshot` as this
+    process's shard file pair: raw-byte npz (uint8 spill, the
+    DiskOptimizerStore convention — .npy silently degrades ml_dtypes) plus a
+    json index mapping each leaf's shards back to normalized global-index
+    slices.  Both writes are tmp+rename atomic and the INDEX lands last, so
+    a killed writer leaves an index-less (detectably partial) payload."""
+    npz_name, json_name = _staged_files(key, rank)
+    arrays: Dict[str, np.ndarray] = {}
+    index: Dict[str, Any] = {"version": 1, "rank": rank, "leaves": []}
+    for i, (kind, rec) in enumerate(records):
+        if kind == "static":
+            arrays[f"leaf{i}_static"] = np.asarray(rec)
+            index["leaves"].append({"kind": "static"})
+            continue
+        shape, dtype, shards = rec
+        entry = {
+            "kind": "array",
+            "shape": list(shape),
+            "dtype": np.dtype(dtype).name,
+            "shards": [],
+        }
+        for j, (norm_idx, data, shard_shape) in enumerate(shards):
+            name = f"leaf{i}_shard{j}"
+            flat = np.ascontiguousarray(data).reshape(-1)
+            arrays[name] = flat.view(np.uint8) if flat.size else flat.astype(
+                np.uint8
+            )
+            entry["shards"].append({
+                "name": name,
+                "index": [list(t) for t in norm_idx],
+                "shape": list(shard_shape),
+            })
+        index["leaves"].append(entry)
+    npz_path = os.path.join(tag_dir, npz_name)
+    # ".tmp" suffix is load-bearing: manifest digesting skips in-flight
+    # writes by exactly that suffix (resilience._walk_files) — another
+    # rank's manifest must never list this file until the rename lands
+    tmp = npz_path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, npz_path)
+    json_path = os.path.join(tag_dir, json_name)
+    tmp = json_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(index, f)
+    os.replace(tmp, json_path)
+
+
+def _load_staged(tag_dir: str, key: str, like: Any, processes: int) -> Any:
+    """Reassemble one state tree from EVERY process's staged shard files
+    onto the CURRENT layout.  Shards are written against normalized
+    global-index slices, so reassembly is topology-free by construction —
+    a v4-32 save restores onto a v4-16 mesh (or any other) because the
+    target shardings come from ``like``, not from the writer's mesh (the
+    elastic-resume property, ISSUE 14)."""
+    from stoke_tpu.parallel.sharding import place_global_tree
+
+    per_rank = []
+    for r in range(max(processes, 1)):
+        npz_name, json_name = _staged_files(key, r)
+        with open(os.path.join(tag_dir, json_name)) as f:
+            index = json.load(f)
+        data = np.load(os.path.join(tag_dir, npz_name))
+        per_rank.append((index, data))
+    leaves_like, treedef = _flat_arrays(like)
+    n = len(per_rank[0][0]["leaves"])
+    if n != len(leaves_like):
+        raise ValueError(
+            f"Stoke -- staged checkpoint {key} has {n} leaves; current "
+            f"state has {len(leaves_like)} (model/optimizer structure "
+            f"changed?)"
+        )
+    placed = []
+    for i, ref in enumerate(leaves_like):
+        entry = per_rank[0][0]["leaves"][i]
+        if entry["kind"] == "static":
+            placed.append(per_rank[0][1][f"leaf{i}_static"])
+            continue
+        shape = tuple(entry["shape"])
+        dtype = _np_dtype(entry["dtype"])
+        out = np.zeros(shape, dtype)
+        for index, data in per_rank:
+            for shard in index["leaves"][i]["shards"]:
+                raw = data[shard["name"]]
+                shard_shape = tuple(shard["shape"])
+                value = (
+                    raw.view(dtype).reshape(shard_shape)
+                    if raw.size
+                    else np.zeros(shard_shape, dtype)
+                )
+                sl = tuple(
+                    slice(s, e, st) for s, e, st in shard["index"]
+                )
+                out[sl] = value
+        if hasattr(ref, "sharding"):
+            placed.append(
+                place_global_tree(
+                    out.astype(ref.dtype, copy=False), ref.sharding
+                )
+            )
+        else:
+            placed.append(out)
+    for _index, data in per_rank:
+        data.close()
+    return jax.tree_util.tree_unflatten(treedef, placed)
+
+
 def _orbax_checkpointer():
     import orbax.checkpoint as ocp
 
@@ -179,6 +309,9 @@ def save_checkpoint(
     backward_step: int,
     grad_buf: Any = None,
     manifest: bool = False,
+    topology: Optional[Dict[str, Any]] = None,
+    chaos: Any = None,
+    on_durable: Optional[Any] = None,
 ) -> str:
     """Write one logical checkpoint; returns the tag directory path.
 
@@ -197,6 +330,34 @@ def save_checkpoint(
     trusting a checkpoint (corrupt/partial tags are quarantined, never
     loaded).  Written LAST on both the sync and async paths, so a tag with
     a manifest is a tag whose write finished.
+
+    ``topology`` (ISSUE 14): the saving run's topology/sharding descriptor
+    (mesh shape, process count, tier, ``shard_updates``, comm bucket
+    layout) embedded in the manifest — what ``Stoke.resume()`` reads to
+    re-shard state onto a DIFFERENT mesh and to quarantine genuinely
+    incompatible checkpoints with a remedy named.
+
+    ``config.offload_staging`` (ISSUE 14 tentpole a): the async
+    consolidated save stages device→host through
+    ``offload.StagedSnapshot`` instead of completing a blocking gather on
+    the main thread — the step path pays one copy-program dispatch, the
+    transfers land off the critical path, and EVERY process writes its own
+    ``<key>.staged.rank<N>.npz`` shard files (no collective anywhere on
+    the save path).  ``meta.json`` records the staged layout so load and
+    the resume-time validator know how many rank files completeness
+    requires.
+
+    ``chaos`` (ISSUE 14 satellite): the run's ``ChaosInjector`` — its
+    ``kill_during_save`` hook fires from the background writer AFTER the
+    payload and BEFORE ``meta.json``, proving a mid-save death leaves a
+    detectably partial (never loadable, always quarantined) tag.
+
+    ``on_durable`` (ISSUE 14 satellite): zero-arg callback invoked once
+    THIS save's write has fully landed — synchronously for sync saves,
+    from the background thread after ``meta.json`` for async ones.  The
+    facade's lost-goodput accounting hangs off it: a save only counts as
+    "the last durable save" when its own write succeeded, never at
+    dispatch (an in-flight or failed save must keep counting as lost).
     """
     root = make_folder(path)
     tag = checkpoint_tag(name, backward_step)
@@ -224,6 +385,8 @@ def save_checkpoint(
     }
     if grad_buf is not None:
         state["grad_buf"] = grad_buf
+    staged_meta: Optional[Dict[str, Any]] = None
+
     def _write_meta_files(fmt_value: str) -> None:
         """meta.json + extras.pkl — the ``save_rank`` writer only; shared by
         the sync and async paths so the metadata schema can never drift
@@ -244,6 +407,12 @@ def save_checkpoint(
             "status": status,
             "name": name,
         }
+        if staged_meta is not None:
+            # staged layout marker (ISSUE 14): load + the resume-time
+            # validator derive "which rank files must exist" from this —
+            # a kill that stranded another rank's shard file mid-write
+            # must read as a partial tag, not a short checkpoint
+            meta["staged"] = staged_meta
         with open(os.path.join(tag_dir, "meta.json"), "w") as f:
             json.dump(meta, f, indent=2, default=str)
         if manifest:
@@ -252,10 +421,12 @@ def save_checkpoint(
             # the manifest can never claim files a crashed write lost
             from stoke_tpu.resilience import write_manifest
 
-            write_manifest(
-                tag_dir,
-                extra={"backward_step": backward_step, "name": name},
-            )
+            extra = {"backward_step": backward_step, "name": name}
+            if topology is not None:
+                # topology/sharding descriptor (ISSUE 14): the record
+                # elastic resume re-shards against
+                extra["topology"] = topology
+            write_manifest(tag_dir, extra=extra)
 
     def _write_meta():
         if jax.process_index() == writer:
@@ -297,6 +468,51 @@ def save_checkpoint(
                     h.close()
 
             fmt_value = CheckpointFormat.sharded.value
+        elif getattr(config, "offload_staging", False):
+            # zero-stall staged save (ISSUE 14 tentpole a): the main
+            # thread issues the decoupling copy + async host transfers and
+            # returns — no gather, no collective.  The background thread
+            # resolves the landed shards and writes THIS process's shard
+            # files; every process writes its own, so the layout needs no
+            # cross-host coordination beyond the meta-side completeness
+            # marker recorded below.
+            from stoke_tpu import offload
+
+            rank = jax.process_index()
+            nproc = max(jax.process_count(), 1)
+            try:
+                # traced: the staged save's main-thread (step-path) cost —
+                # ONE copy-program dispatch for the whole state dict.  One
+                # snapshot per SAVE, not per state tree: the double buffer
+                # bounds in-flight SAVES at two, so staging a save's later
+                # trees can never force-resolve its own earlier trees on
+                # the main thread (which would be the gather stall under a
+                # different name).
+                with trace_span("stoke/ckpt_save", track="io",
+                                attrs={"tag": tag, "async": True,
+                                       "staged": True}):
+                    staged_snap = offload.stage_tree(state)
+            except BaseException:
+                _INFLIGHT_TAGS.discard(tag_dir)
+                raise
+            staged_meta = {"processes": nproc, "keys": sorted(state)}
+            # flatten order of the combined dict is key-sorted; each key's
+            # leaves are a contiguous record slice in that order
+            key_counts = [
+                (k, len(jax.tree_util.tree_leaves(state[k])))
+                for k in sorted(state)
+            ]
+
+            def _write_payload():
+                _treedef, records = staged_snap.resolve()
+                off = 0
+                for key, n in key_counts:
+                    _write_staged_payload(
+                        tag_dir, key, rank, records[off:off + n]
+                    )
+                    off += n
+
+            fmt_value = CheckpointFormat.consolidated.value
         else:
             # consolidated: gather (collective, main thread) → proc-0 write
             try:
@@ -326,11 +542,21 @@ def save_checkpoint(
         def _bg():
             try:
                 _write_payload()
+                if chaos is not None:
+                    # kill_during_save injector (ISSUE 14 satellite):
+                    # SIGKILL between payload and meta.json — the
+                    # half-staged state a preempted host really leaves
+                    chaos.on_async_payload(tag_dir)
                 _write_meta_files(fmt_value)
                 # meta.json is on disk: this tag is now a complete, loadable
                 # checkpoint — leave the in-flight set BEFORE pruning so it
                 # counts toward its own keep window
                 _INFLIGHT_TAGS.discard(tag_dir)
+                if on_durable is not None:
+                    try:
+                        on_durable()
+                    except Exception:
+                        pass  # accounting must never fail a landed save
                 if is_writer:
                     _prune_old(root, name, config.max_to_keep)
                     unrolled_print(f"Saved checkpoint {tag_dir} (async)")
@@ -367,6 +593,11 @@ def save_checkpoint(
             _save_sharded(tag_dir, state)
         _write_meta()
         _barrier()
+    if on_durable is not None:
+        try:
+            on_durable()
+        except Exception:
+            pass
     return tag_dir
 
 
@@ -388,6 +619,15 @@ def wait_for_saves() -> None:
     failure with "+2 more"); the first underlying exception chains as the
     cause and the rest are summarized inline."""
     with trace_span("stoke/ckpt_wait", track="io"):
+        # staged landing buffers FIRST (ISSUE 14): an offload-staged save
+        # still mid-flight holds device-side snapshot copies whose host
+        # transfers must land before any synchronous gather this caller
+        # runs next (the emergency save's).  Thread joins alone would
+        # cover it eventually, but the explicit drain pins the ordering:
+        # staging resolves, then writer threads, then the barrier.
+        from stoke_tpu.offload import drain_staged
+
+        drain_staged()
         while _ASYNC_SAVES:
             _ASYNC_SAVES.pop().join()
         _barrier()
@@ -487,7 +727,20 @@ def load_checkpoint(
     with open(os.path.join(tag_dir, "meta.json")) as f:
         meta = json.load(f)
     fmt = CheckpointFormat(meta["format"])
-    loader = _load_consolidated if fmt is CheckpointFormat.consolidated else _load_sharded
+    staged = meta.get("staged")
+    if staged:
+        # offload-staged layout (ISSUE 14): per-process shard files keyed
+        # by normalized global indices — reassembled onto the CURRENT
+        # shardings, so the writer's topology is irrelevant at load
+        import functools
+
+        loader = functools.partial(
+            _load_staged, processes=int(staged.get("processes", 1))
+        )
+    elif fmt is CheckpointFormat.consolidated:
+        loader = _load_consolidated
+    else:
+        loader = _load_sharded
     payload = {
         "variables": loader(tag_dir, "variables", variables_like),
         "opt_state": loader(tag_dir, "opt_state", opt_state_like),
@@ -496,9 +749,13 @@ def load_checkpoint(
         "status": meta["status"],
         "grad_buf": None,
     }
-    has_buf = os.path.exists(
-        os.path.join(tag_dir, "grad_buf.npz")
-    ) or os.path.exists(os.path.join(tag_dir, "grad_buf.orbax"))
+    has_buf = (
+        os.path.exists(os.path.join(tag_dir, "grad_buf.npz"))
+        or os.path.exists(os.path.join(tag_dir, "grad_buf.orbax"))
+        or os.path.exists(
+            os.path.join(tag_dir, _staged_files("grad_buf", 0)[0])
+        )
+    )
     if grad_buf_like is not None and has_buf:
         payload["grad_buf"] = loader(tag_dir, "grad_buf", grad_buf_like)
     extras_path = os.path.join(tag_dir, "extras.pkl")

@@ -26,13 +26,20 @@ from __future__ import annotations
 
 import os
 import shutil
+import threading
 import weakref
-from typing import Any, Optional
+from collections import deque
+from typing import Any, List, Optional, Tuple
 
 import jax
 import numpy as np
 
-__all__ = ["DiskOptimizerStore"]
+__all__ = [
+    "DiskOptimizerStore",
+    "StagedSnapshot",
+    "stage_tree",
+    "drain_staged",
+]
 
 
 def _cleanup_dirs(directory: str, cleanup_root: Optional[str] = None) -> None:
@@ -207,3 +214,166 @@ class DiskOptimizerStore:
         self._finalizer.detach()
         _cleanup_dirs(self._dir, self._cleanup_root)
         self._spec = None
+
+
+# --------------------------------------------------------------------------- #
+# Device→host checkpoint staging (ISSUE 14 tentpole a: zero-stall saves)
+# --------------------------------------------------------------------------- #
+#
+# The async checkpoint path used to complete a full device→host gather ON THE
+# MAIN THREAD before the background writer took over (io_ops.py
+# ``_gather_to_host``) — every periodic save stalled the step for the whole
+# transfer.  :class:`StagedSnapshot` splits that into three phases:
+#
+#   1. **Decouple** (main thread, one dispatch): the state pytree runs
+#      through a tiny compiled identity program producing FRESH device
+#      buffers.  This matters because the very next optimizer step DONATES
+#      the live state arrays — donation deletes every alias, including
+#      pending-copy references — so the snapshot must not share buffers
+#      with anything the step path owns.
+#   2. **Land** (async, off the critical path): ``copy_to_host_async`` is
+#      issued per addressable shard, so the device→host DMA overlaps the
+#      following steps' compute instead of blocking before them.
+#   3. **Resolve** (background writer thread): materialize host numpy from
+#      the landed copies and release the snapshot's device buffers.
+#
+# In-flight snapshots are bounded (double buffering, :data:`MAX_STAGED`):
+# staging a third snapshot first drains the oldest, so a slow disk can never
+# accumulate unbounded HBM/host copies of the training state.
+
+#: maximum staged snapshots in flight (the double buffer)
+MAX_STAGED = 2
+
+#: live (unresolved) snapshots, oldest first — module-global like
+#: io_ops._ASYNC_SAVES so ``wait_for_saves`` can drain staging buffers
+#: before any synchronous gather (the emergency-save ordering contract)
+_INFLIGHT_STAGED: "deque[StagedSnapshot]" = deque()
+_STAGED_LOCK = threading.Lock()
+
+
+def _snapshot_copy(tree: Any) -> Any:
+    """Compiled identity copy of every jax.Array leaf: one async dispatch,
+    fresh un-aliased buffers (see phase 1 above).  Non-array leaves pass
+    through untouched."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    idx = [i for i, l in enumerate(leaves) if isinstance(l, jax.Array)]
+    if idx:
+        copies = _copy_arrays([leaves[i] for i in idx])
+        for i, c in zip(idx, copies):
+            leaves[i] = c
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+@jax.jit
+def _copy_arrays(arrays: List[jax.Array]) -> List[jax.Array]:
+    # jnp.copy under jit lowers to a pure copy program; outputs inherit the
+    # input shardings and are NEW buffers (no donation declared, no alias)
+    import jax.numpy as jnp
+
+    return [jnp.copy(a) for a in arrays]
+
+
+class StagedSnapshot:
+    """One pytree mid-flight from device to host.
+
+    Construction is the zero-stall part: it dispatches the decoupling copy
+    and issues the async host transfers, then returns.  :meth:`resolve`
+    (idempotent, thread-safe — whoever calls first does the work) blocks
+    until the copies land and returns the host-side records::
+
+        (treedef, [("static", value)
+                   | ("array", (shape, dtype, [(norm_index, np shard,
+                                                shard_shape), ...]))])
+
+    Replicated shards are deduplicated by normalized index (the
+    :class:`DiskOptimizerStore` convention), so a snapshot carries each
+    distinct shard of this process exactly once.
+    """
+
+    def __init__(self, tree: Any):
+        snap = _snapshot_copy(tree)
+        leaves, self._treedef = jax.tree_util.tree_flatten(snap)
+        self._pending: List[Any] = []
+        for leaf in leaves:
+            if not isinstance(leaf, jax.Array):
+                self._pending.append(("static", leaf))
+                continue
+            shape, dtype = leaf.shape, np.dtype(leaf.dtype)
+            shards = []
+            seen = set()
+            for shard in leaf.addressable_shards:
+                key = _norm_index(shard.index, shape)
+                if key in seen:
+                    continue  # replicated across local devices: stage once
+                seen.add(key)
+                shard.data.copy_to_host_async()
+                shards.append((key, shard.data))
+            self._pending.append(("array", (shape, dtype, shards)))
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+        self._resolved: Optional[Tuple[Any, List[Any]]] = None
+        with _STAGED_LOCK:
+            _INFLIGHT_STAGED.append(self)
+
+    @property
+    def resolved(self) -> bool:
+        return self._done.is_set()
+
+    def resolve(self) -> Tuple[Any, List[Any]]:
+        """Host numpy records of the staged tree (see class docstring);
+        blocks on whatever transfers have not landed yet, releases the
+        snapshot's device buffers, and unregisters from the in-flight
+        deque.  Safe to call from any thread, any number of times."""
+        with self._lock:
+            if self._resolved is None:
+                records: List[Any] = []
+                for kind, rec in self._pending:
+                    if kind == "static":
+                        records.append((kind, rec))
+                        continue
+                    shape, dtype, shards = rec
+                    host_shards = []
+                    for key, data in shards:
+                        arr = np.asarray(data)
+                        host_shards.append((key, arr, arr.shape))
+                        try:
+                            data.delete()
+                        except Exception:
+                            pass
+                    records.append(("array", (shape, dtype, host_shards)))
+                self._pending = []
+                self._resolved = (self._treedef, records)
+                self._done.set()
+                with _STAGED_LOCK:
+                    try:
+                        _INFLIGHT_STAGED.remove(self)
+                    except ValueError:
+                        pass
+        return self._resolved
+
+
+def stage_tree(tree: Any) -> StagedSnapshot:
+    """Stage one pytree for a background checkpoint write.  Enforces the
+    double buffer: with :data:`MAX_STAGED` snapshots already in flight the
+    OLDEST is resolved (blocking) first — bounding snapshot memory at two
+    copies of the state regardless of how slow the writer is."""
+    while True:
+        with _STAGED_LOCK:
+            if len(_INFLIGHT_STAGED) < MAX_STAGED:
+                break
+            oldest = _INFLIGHT_STAGED[0]
+        oldest.resolve()
+    return StagedSnapshot(tree)
+
+
+def drain_staged() -> None:
+    """Resolve every in-flight staged snapshot (blocking).  io_ops
+    ``wait_for_saves`` calls this BEFORE joining writer threads, so an
+    emergency save's carefully-sequenced synchronous gather can never
+    overlap a half-landed staging copy (the ISSUE 14 ordering contract)."""
+    while True:
+        with _STAGED_LOCK:
+            if not _INFLIGHT_STAGED:
+                return
+            snap = _INFLIGHT_STAGED[0]
+        snap.resolve()

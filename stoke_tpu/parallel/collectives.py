@@ -235,6 +235,35 @@ class GradTransport:
             for indices, _, _ in layout.buckets
         ]
 
+    #: residual layout kind this transport carries ("replicated": per-leaf
+    #: pytree; zero.py's sharded variant overrides with "sharded": per-
+    #: bucket flat buffers) — part of the ISSUE 14 topology descriptor
+    layout_kind = "replicated"
+
+    def layout_descriptor(self, params: Any) -> Optional[Dict[str, Any]]:
+        """The transport's state-layout descriptor (ISSUE 14): everything
+        elastic resume needs to re-map an error-feedback residual saved
+        under THIS layout onto a different one — the residual kind, the
+        data-axis world size the bucket padding was aligned for, the
+        per-leaf element counts (flatten order), and the per-bucket
+        (payload, padded) element counts.  None for an inactive
+        transport (no state to re-map)."""
+        if not self.active:
+            return None
+        leaves = jax.tree_util.tree_leaves(params)
+        sizes = self._leaf_sizes(leaves)
+        layout = self._layout(sizes)
+        return {
+            "kind": self.layout_kind,
+            "world": int(self.world),
+            "error_feedback": bool(self.error_feedback),
+            "leaf_sizes": [int(s) for s in sizes],
+            "buckets": [
+                [int(elems), int(padded)]
+                for _indices, elems, padded in layout.buckets
+            ],
+        }
+
     def _wire_bytes(self, elems: int, stages: float) -> Tuple[int, int]:
         """Per-device bytes of ``stages`` ring stages over one padded
         payload — ``(N-1)/N × payload`` each — in fp32 (``pre``) vs the

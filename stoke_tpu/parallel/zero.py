@@ -79,6 +79,10 @@ class ShardedGradTransport(GradTransport):
     each bucket's norm back to the module groups whose gradients ride it.
     """
 
+    #: ISSUE 14 topology descriptor: the residual is per-bucket flat
+    #: buffers placed P(axis), not the replicated per-leaf pytree
+    layout_kind = "sharded"
+
     def __init__(
         self,
         cfg: Optional[CommConfig],
@@ -233,6 +237,94 @@ class ShardedGradTransport(GradTransport):
             out_specs=(P(axis), P(axis)),
         )
         return fn(flat, res, rng)
+
+
+# --------------------------------------------------------------------------- #
+# Residual partition algebra (ISSUE 14 tentpole b: topology-elastic resume)
+# --------------------------------------------------------------------------- #
+#
+# The error-feedback residual is logically ONE flat f32 vector over the
+# parameter elements (flatten order) — every layout is just a packing of
+# it: the replicated transport packs it per leaf, the sharded transport as
+# per-bucket padded buffers whose bucket splits and padding depend on
+# ``bucket_mb``, ``chunk_elems``, and the data-axis WORLD SIZE (the ZeRO
+# weight-update-sharding partition rule, arXiv:2004.13336).  Re-mapping a
+# residual saved on one topology onto another is therefore: unpack to the
+# flat vector under the SAVED descriptor, repack under the CURRENT one.
+# Pure host numpy, unit-testable without a mesh.
+
+
+def residual_to_flat(residual: Any, desc: Dict[str, Any]) -> np.ndarray:
+    """Unpack a host-side residual into the flat per-element f32 vector
+    under its layout descriptor (``GradTransport.layout_descriptor``)."""
+    if desc["kind"] == "sharded":
+        parts = [
+            np.asarray(buf, np.float32).reshape(-1)[:elems]
+            for buf, (elems, _padded) in zip(residual, desc["buckets"])
+        ]
+        return (
+            np.concatenate(parts) if parts else np.zeros((0,), np.float32)
+        )
+    leaves = jax.tree_util.tree_leaves(residual)
+    if not leaves:
+        return np.zeros((0,), np.float32)
+    return np.concatenate(
+        [np.asarray(l, np.float32).reshape(-1) for l in leaves]
+    )
+
+
+def flat_to_residual(
+    flat: np.ndarray, desc: Dict[str, Any], template: Any
+) -> Any:
+    """Repack the flat residual vector under a target layout descriptor.
+    ``template`` is the CURRENT run's residual state (host or device) —
+    the treedef/leaf-shape source for the replicated packing; only its
+    structure is read."""
+    flat = np.asarray(flat, np.float32).reshape(-1)
+    total = int(sum(desc["leaf_sizes"]))
+    if flat.size != total:
+        raise ValueError(
+            f"Stoke -- residual re-map size mismatch: flat vector has "
+            f"{flat.size} elements, target layout covers {total} "
+            f"(different model?)"
+        )
+    if desc["kind"] == "sharded":
+        out, off = [], 0
+        for elems, padded in desc["buckets"]:
+            buf = np.zeros((int(padded),), np.float32)
+            buf[:elems] = flat[off:off + elems]
+            off += elems
+            out.append(buf)
+        return tuple(out)
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    out, off = [], 0
+    for leaf in leaves:
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        n = int(np.prod(shape)) if shape else 1
+        out.append(flat[off:off + n].reshape(shape))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def remap_residual(
+    residual: Any,
+    saved_desc: Dict[str, Any],
+    target_desc: Dict[str, Any],
+    target_template: Any,
+) -> Any:
+    """Re-map a host-side residual saved under ``saved_desc`` onto
+    ``target_desc``'s layout (different world size, bucket padding, or
+    replicated↔sharded kind).  Raises ``ValueError`` on element-count
+    mismatch — a residual from a different MODEL cannot re-map and the
+    caller degrades to dropping it."""
+    flat = residual_to_flat(residual, saved_desc)
+    total = int(sum(target_desc["leaf_sizes"]))
+    if flat.size != total:
+        raise ValueError(
+            f"Stoke -- residual re-map: saved residual covers {flat.size} "
+            f"elements, current model {total} (incompatible checkpoint)"
+        )
+    return flat_to_residual(flat, target_desc, target_template)
 
 
 def make_transport(

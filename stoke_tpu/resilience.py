@@ -220,10 +220,17 @@ def _file_sha256(path: str, chunk: int = 1 << 20) -> str:
 
 def _walk_files(tag_dir: str) -> List[str]:
     """Relative paths of every regular file under ``tag_dir`` (sorted; the
-    manifest itself excluded)."""
+    manifest itself excluded).  ``*.tmp`` names are excluded too: every
+    writer in this repo lands files atomically via tmp+``os.replace``, so
+    a ``.tmp`` is by definition an in-flight write — digesting one (e.g.
+    another rank's staged shard mid-write, ISSUE 14) would bake a
+    transient name into the manifest and permanently fail verification of
+    a healthy checkpoint once the rename retires it."""
     out = []
     for root, _dirs, files in os.walk(tag_dir):
         for name in files:
+            if name.endswith(".tmp"):
+                continue
             rel = os.path.relpath(os.path.join(root, name), tag_dir)
             if rel != MANIFEST_NAME:
                 out.append(rel)
@@ -272,9 +279,14 @@ def verify_checkpoint(
     Validation ladder:
       1. ``meta.json`` must exist and parse (async saves write it last — a
          meta-less tag is a partial write by construction).
-      2. With a manifest: every listed file must exist with a matching
+      2. A staged (offload) layout must be COMPLETE: meta records how many
+         processes wrote shard files for which state keys (ISSUE 14) —
+         every process's writer runs independently, so a hard kill can
+         strand meta.json ahead of a lagging rank's payload; the missing
+         rank file is the half-staged signature this check catches.
+      3. With a manifest: every listed file must exist with a matching
          sha256 digest (bit rot, truncation, chaos-injected corruption).
-      3. Without a manifest: valid iff ``require_manifest`` is False
+      4. Without a manifest: valid iff ``require_manifest`` is False
          (pre-resilience checkpoints stay loadable).
     """
     meta_path = os.path.join(tag_dir, "meta.json")
@@ -284,9 +296,24 @@ def verify_checkpoint(
         return False, "missing meta.json (partial write)"
     try:
         with open(meta_path) as f:
-            json.load(f)
+            meta = json.load(f)
     except (OSError, ValueError) as e:
         return False, f"unreadable meta.json ({e})"
+    staged = meta.get("staged") if isinstance(meta, dict) else None
+    if staged:
+        try:
+            processes = int(staged["processes"])
+            keys = list(staged["keys"])
+        except (KeyError, TypeError, ValueError):
+            return False, "malformed staged marker in meta.json"
+        for key in keys:
+            for r in range(max(processes, 1)):
+                for suffix in ("npz", "json"):
+                    rel = f"{key}.staged.rank{r}.{suffix}"
+                    if not os.path.exists(os.path.join(tag_dir, rel)):
+                        return False, (
+                            f"staged payload incomplete: missing {rel}"
+                        )
     manifest_path = os.path.join(tag_dir, MANIFEST_NAME)
     if not os.path.exists(manifest_path):
         if require_manifest:
@@ -310,6 +337,18 @@ def verify_checkpoint(
         except OSError as e:
             return False, f"unreadable file {rel} ({e})"
     return True, "ok"
+
+
+def read_manifest(tag_dir: str) -> Optional[Dict[str, Any]]:
+    """The parsed ``manifest.json`` of a checkpoint tag, or None when the
+    tag carries none / it is unreadable.  The manifest is where ISSUE 14's
+    topology/sharding descriptor lives (``manifest["topology"]``) — what
+    elastic resume reads to re-shard and to reject incompatible saves."""
+    try:
+        with open(os.path.join(tag_dir, MANIFEST_NAME)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
 
 
 def quarantine_checkpoint(tag_dir: str, reason: str = "") -> Optional[str]:
@@ -382,6 +421,7 @@ def find_latest_valid_checkpoint(
     quarantine: bool = True,
     require_manifest: bool = False,
     on_quarantine: Optional[Callable[[str, Optional[str], str], None]] = None,
+    validate_fn: Optional[Callable[[str], Tuple[bool, str]]] = None,
 ) -> Optional[Dict[str, Any]]:
     """Newest VALID checkpoint across ``roots`` (``(root, name)`` pairs;
     ``name=None`` matches any run name).
@@ -393,6 +433,12 @@ def find_latest_valid_checkpoint(
     corrupted-latest-checkpoint acceptance path.  ``on_quarantine(tag_dir,
     quarantined_path, reason)`` is invoked per quarantined tag (telemetry
     counters, operator warnings).
+
+    ``validate_fn(tag_dir) -> (ok, reason)`` runs AFTER the integrity
+    checks pass (ISSUE 14): the facade's topology-descriptor compatibility
+    check rides here, so a digest-clean checkpoint whose descriptor cannot
+    serve the current run (different model) is quarantined with the remedy
+    named instead of crashing the restore mid-flight.
     """
     candidates: List[Dict[str, Any]] = []
     for root, name in roots:
@@ -409,6 +455,11 @@ def find_latest_valid_checkpoint(
         ok, reason = verify_checkpoint(
             cand["tag_dir"], require_manifest=require_manifest
         )
+        if ok and validate_fn is not None:
+            try:
+                ok, reason = validate_fn(cand["tag_dir"])
+            except Exception as e:  # a broken validator must not resume
+                ok, reason = False, f"descriptor validation failed ({e})"
         if ok:
             return cand
         dest = (
@@ -441,7 +492,10 @@ class ChaosSpec:
     ``kill_at_step=K`` (+ optional ``kill_mode=sigterm|sigkill|exception``),
     ``corrupt_save=N`` (corrupt the N-th checkpoint this process writes,
     1-based), ``wedge_at_step=K`` (+ ``wedge_s=S`` seconds) stalling the
-    dispatch AFTER step K completes.  Example::
+    dispatch AFTER step K completes, ``kill_during_save=N`` (SIGKILL from
+    INSIDE the N-th async save's background writer, after the payload and
+    before ``meta.json`` — the half-staged death the manifest validator
+    must detect and quarantine, ISSUE 14).  Example::
 
         STOKE_CHAOS="kill_at_step=5,kill_mode=sigterm"
     """
@@ -451,6 +505,7 @@ class ChaosSpec:
     corrupt_save: Optional[int] = None
     wedge_at_step: Optional[int] = None
     wedge_s: float = 1.0
+    kill_during_save: Optional[int] = None
 
     @property
     def active(self) -> bool:
@@ -458,6 +513,7 @@ class ChaosSpec:
             self.kill_at_step is not None
             or self.corrupt_save is not None
             or self.wedge_at_step is not None
+            or self.kill_during_save is not None
         )
 
 
@@ -504,7 +560,8 @@ def parse_chaos(spec: Optional[str]) -> Optional[ChaosSpec]:
                 ) from e
     # an armed injector that can never fire is a fake-green chaos run —
     # the same contract as unknown keys: loud, never a silent no-op
-    for key in ("kill_at_step", "corrupt_save", "wedge_at_step"):
+    for key in ("kill_at_step", "corrupt_save", "wedge_at_step",
+                "kill_during_save"):
         v = getattr(out, key)
         if v is not None and v < 1:
             raise ValueError(
@@ -533,6 +590,7 @@ class ChaosInjector:
     def __init__(self, spec: Optional[ChaosSpec]):
         self.spec = spec
         self._saves_seen = 0
+        self._async_payloads_seen = 0
         self._completed_step: Optional[int] = None
         self._resume_anchor: Optional[int] = None
         self._wedged = False
@@ -597,6 +655,25 @@ class ChaosInjector:
                 f"{self.spec.wedge_s}s after step {self._completed_step}\n"
             )
             time.sleep(self.spec.wedge_s)
+
+    def on_async_payload(self, tag_dir: str) -> None:
+        """Background-writer hook (``io_ops`` calls it between the payload
+        write and ``meta.json``): ``kill_during_save=N`` SIGKILLs the
+        process from inside the N-th async save — payload files on disk,
+        no loadable marker, no manifest.  The resulting tag MUST read as a
+        partial write to the resume-time validator and be quarantined,
+        never resumed from (the ISSUE 14 chaos acceptance)."""
+        self._async_payloads_seen += 1
+        if not self.active:
+            return
+        if self.spec.kill_during_save == self._async_payloads_seen:
+            sys.stderr.write(
+                f"Stoke -- CHAOS: kill_during_save="
+                f"{self.spec.kill_during_save} SIGKILLing mid-save of "
+                f"{tag_dir}\n"
+            )
+            sys.stderr.flush()
+            os.kill(os.getpid(), signal.SIGKILL)
 
     def note_saved(self, tag_dir: str) -> None:
         """Checkpoint-writer hook: corrupts the bytes of the N-th save this
@@ -680,6 +757,7 @@ class ResilienceMonitor:
         self.resumed_step: Optional[int] = None
         self.lost_steps: Optional[int] = None
         self.emergency_tag: Optional[str] = None
+        self.elastic_resume: Optional[Dict[str, Any]] = None
         # pre-register so scrapes carry zeros before the first event
         registry.counter(
             "resilience/preemptions_total",
@@ -692,6 +770,11 @@ class ResilienceMonitor:
         registry.counter(
             "resilience/quarantined_ckpts_total",
             help="corrupt/partial checkpoint tags quarantined at resume",
+        )
+        registry.counter(
+            "resilience/elastic_resumes_total",
+            help="resumes that re-sharded state saved on a DIFFERENT "
+            "topology (mesh/process-count/tier change)",
         )
         registry.gauge(
             "resilience/restarts",
@@ -785,6 +868,18 @@ class ResilienceMonitor:
                          reason: str) -> None:
         self.registry.counter("resilience/quarantined_ckpts_total").inc()
 
+    def note_elastic_resume(
+        self,
+        saved: Optional[Dict[str, Any]],
+        current: Optional[Dict[str, Any]],
+    ) -> None:
+        """Record one topology-elastic resume (ISSUE 14): the restored
+        checkpoint was saved under a different (mesh, process count, tier,
+        shard_updates) than this run — params/opt/EF state were re-sharded
+        onto the new layout at load."""
+        self.elastic_resume = {"from": saved, "to": current}
+        self.registry.counter("resilience/elastic_resumes_total").inc()
+
     def note_resumed(self, step: int,
                      lost_steps: Optional[int] = None) -> None:
         """Record where this run resumed from: ``resumed_step`` gauges the
@@ -843,6 +938,9 @@ class ResilienceMonitor:
             "resilience/lost_steps": (
                 None if self.lost_steps is None else float(self.lost_steps)
             ),
+            "resilience/elastic_resumes": _val(
+                "resilience/elastic_resumes_total"
+            ),
         }
 
     def summary(self) -> Dict[str, Any]:
@@ -860,6 +958,8 @@ class ResilienceMonitor:
             "resumed_step": self.resumed_step,
             "lost_steps": self.lost_steps,
             "emergency_tag": self.emergency_tag,
+            "elastic_resumes": _int("resilience/elastic_resumes_total"),
+            "elastic_resume": self.elastic_resume,
             "chaos_active": self.chaos.active,
         }
 

@@ -32,6 +32,7 @@ from stoke_tpu.configs import (
     ActivationCheckpointingConfig,
     AttributionConfig,
     CheckpointConfig,
+    CheckpointFormat,
     HealthConfig,
     ClipGradConfig,
     ClipGradNormConfig,
@@ -644,6 +645,23 @@ class StokeStatus:
                     f"{list(FLEET_ACTIONS)} (halt is not allowed — a "
                     f"straggler is a performance diagnosis, not fatal)"
                 )
+            if cfg.rebalance:
+                # skew-reactive input rebalancing (ISSUE 14): the bounded
+                # actuator's knobs must be able to act — a zero step size
+                # or an empty/full share band is a silently-dead actuator
+                # (the chaos-spec discipline: loud, never a no-op)
+                if cfg.rebalance_rows < 1:
+                    return (
+                        f"FleetConfig.rebalance_rows must be >= 1, got "
+                        f"{cfg.rebalance_rows}"
+                    )
+                if not (0.0 < cfg.rebalance_max_frac < 1.0):
+                    return (
+                        f"FleetConfig.rebalance_max_frac must be in "
+                        f"(0, 1) — a host sheds at most that fraction of "
+                        f"its read share, never all of it; got "
+                        f"{cfg.rebalance_max_frac}"
+                    )
             return False
 
         def _numerics_invalid(s):
@@ -705,6 +723,31 @@ class StokeStatus:
                     f"stats matrix, so with grad_stats=False it can "
                     f"never fire; enable grad_stats or drop the "
                     f"escalated action"
+                )
+            return False
+
+        def _checkpoint_invalid(s):
+            """Checkpoint-layout legality (ISSUE 14): offload staging is
+            the zero-stall path for ASYNC CONSOLIDATED saves — on the
+            sync path there is no background writer to hand the staged
+            references to, and the sharded (orbax) path already stages
+            its own device→host copy."""
+            cfg = self._configs.get("CheckpointConfig")
+            if cfg is None or not getattr(cfg, "offload_staging", False):
+                return False
+            if not cfg.async_save:
+                return (
+                    "CheckpointConfig.offload_staging requires "
+                    "async_save=True — staging hands device references to "
+                    "the background writer; a synchronous save has none. "
+                    "Enable async_save or drop offload_staging"
+                )
+            if cfg.format is CheckpointFormat.sharded:
+                return (
+                    "CheckpointConfig.offload_staging applies to the "
+                    "consolidated format only — the sharded (orbax) async "
+                    "path stages its own device→host copy. Use "
+                    "format='consolidated' or drop offload_staging"
                 )
             return False
 
@@ -1115,6 +1158,10 @@ class StokeStatus:
             (
                 _numerics_invalid,
                 "NumericsConfig is invalid for this combination",
+            ),
+            (
+                _checkpoint_invalid,
+                "CheckpointConfig is invalid",
             ),
             (
                 _resilience_invalid,

@@ -140,19 +140,32 @@ STEP_EVENT_FIELDS: Dict[str, tuple] = {
     "fleet/skew_class": (False, "nullable_string"),
     "fleet/barrier_wait_s": (False, "nullable_number"),
     "fleet/barrier_charged_host": (False, "nullable_number"),
+    # skew-reactive input rebalancing (ISSUE 14; keys absent unless
+    # FleetConfig.rebalance is ON — a rebalance-off fleet run's records
+    # are byte-identical to pre-ISSUE-14 ones): share_self is this host's
+    # current per-slice read share (rows), shift_rows/from/to describe the
+    # actuation applied at THIS window close (null between actuations),
+    # shifts the cumulative actuation count
+    "fleet/rebalance_share_self": (False, "nullable_number"),
+    "fleet/rebalance_shift_rows": (False, "nullable_number"),
+    "fleet/rebalance_from_host": (False, "nullable_number"),
+    "fleet/rebalance_to_host": (False, "nullable_number"),
+    "fleet/rebalance_shifts": (False, "nullable_number"),
     # resilience (ISSUE 7; keys absent without a ResilienceConfig):
     # cumulative preemption notices honored, emergency checkpoints
     # written, corrupt tags quarantined at resume; restarts is the
     # supervisor attempt number this process is (0 = first run);
     # resumed_step the optimizer step this run restored from (null until
     # a resume happens), lost_steps the steps a newer-but-invalid tag
-    # had recorded beyond the resumed one
+    # had recorded beyond the resumed one; elastic_resumes (ISSUE 14) the
+    # resumes that re-sharded state saved on a DIFFERENT topology
     "resilience/preemptions": (False, "nullable_number"),
     "resilience/emergency_saves": (False, "nullable_number"),
     "resilience/quarantined": (False, "nullable_number"),
     "resilience/restarts": (False, "nullable_number"),
     "resilience/resumed_step": (False, "nullable_number"),
     "resilience/lost_steps": (False, "nullable_number"),
+    "resilience/elastic_resumes": (False, "nullable_number"),
     # serving engine (ISSUE 9; keys absent without a ServingEngine emit —
     # training records NEVER carry them): cumulative request/token
     # counters, capacity gauges (queue depth, decode-slot fill, KV-block
@@ -206,6 +219,14 @@ STEP_EVENT_FIELDS: Dict[str, tuple] = {
 #: ``fleet=`` dict; stoke_tpu.telemetry.fleet.FLEET_EVENT_FIELDS must match)
 FLEET_STEP_FIELDS = tuple(
     f for f in STEP_EVENT_FIELDS if f.startswith("fleet/")
+)
+
+#: the rebalance subset (ISSUE 14): emitted ONLY when
+#: ``FleetConfig.rebalance`` is on — the monitor omits these keys from its
+#: window dict otherwise, and ``build_step_event`` honors the omission, so
+#: a rebalance-off run adds zero JSONL fields
+FLEET_REBALANCE_FIELDS = tuple(
+    f for f in FLEET_STEP_FIELDS if f.startswith("fleet/rebalance_")
 )
 
 #: the resilience subset of the schema (populated via ``build_step_event``'s
@@ -451,12 +472,18 @@ def build_step_event(
         # attached; the slash-named fields cannot be python kwargs, so
         # they arrive as one dict — unknown keys fail validation below
         for key in FLEET_STEP_FIELDS:
+            if key in FLEET_REBALANCE_FIELDS and key not in fleet:
+                # rebalance keys ride only when the actuator is configured
+                # (ISSUE 14 default-OFF contract: zero new JSONL fields)
+                continue
             value = fleet.get(key)
             if key == "fleet/skew_class":
                 record[key] = value
             elif key in ("fleet/hosts", "fleet/window",
                          "fleet/straggler_host",
-                         "fleet/barrier_charged_host"):
+                         "fleet/barrier_charged_host",
+                         "fleet/rebalance_from_host",
+                         "fleet/rebalance_to_host"):
                 record[key] = None if value is None else int(value)
             else:
                 record[key] = _round(value)

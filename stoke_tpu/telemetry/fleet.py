@@ -49,7 +49,10 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from stoke_tpu.telemetry.events import FLEET_STEP_FIELDS
+from stoke_tpu.telemetry.events import (
+    FLEET_REBALANCE_FIELDS,
+    FLEET_STEP_FIELDS,
+)
 from stoke_tpu.telemetry.health import Detector as _HealthDetector
 
 #: the goodput buckets mirrored into the packed vector (must match
@@ -85,8 +88,15 @@ N_FLEET_SIGNALS = len(FLEET_SIGNALS)
 #: fleet fields of the JSONL step event — the schema (events.py
 #: STEP_EVENT_FIELDS, where each field's semantics are documented) is the
 #: single source of truth; :meth:`FleetMonitor.window_stats` returns
-#: exactly these keys
+#: exactly these keys (minus the rebalance subset when
+#: ``FleetConfig.rebalance`` is off — ISSUE 14's zero-new-fields contract)
 FLEET_EVENT_FIELDS = FLEET_STEP_FIELDS
+
+#: the fleet fields every FleetConfig run emits (rebalance keys ride only
+#: with the actuator configured)
+FLEET_BASE_FIELDS = tuple(
+    f for f in FLEET_STEP_FIELDS if f not in FLEET_REBALANCE_FIELDS
+)
 
 #: below this fraction of the median window wall, skew is reported as
 #: class "none" (measurement noise, not a straggler signal)
@@ -388,6 +398,16 @@ class FleetMonitor:
         self._pending_straggler: Optional[Dict[str, Any]] = None
         self._straggler_events: List[Dict[str, Any]] = []
         self._warnings = 0
+        # skew-reactive input rebalancing (ISSUE 14 tentpole c): the
+        # actuator is a data.InputRebalancer the DataLoader factory
+        # attaches; None (rebalance off / no loader built) keeps every
+        # path below byte-identical to pre-ISSUE-14 behavior
+        self.rebalancer = None
+        self._rebalance_on = bool(getattr(cfg, "rebalance", False))
+        self._event_keys = (
+            FLEET_EVENT_FIELDS if self._rebalance_on else FLEET_BASE_FIELDS
+        )
+        self._last_shift: Optional[Dict[str, int]] = None
         # pre-register so scrapes carry zeros before the first exchange
         registry.counter(
             "fleet/windows_total", help="fleet exchange windows completed"
@@ -400,6 +420,16 @@ class FleetMonitor:
             "fleet/anomalies_total",
             help="fleet_straggler detector firings (streak >= K windows)",
         )
+        if self._rebalance_on:
+            registry.counter(
+                "fleet/rebalance_shifts_total",
+                help="input-rebalance actuations (loader-classified "
+                "straggler streaks acted on)",
+            )
+            registry.counter(
+                "fleet/rebalance_rows_moved_total",
+                help="per-slice read rows moved off straggler hosts",
+            )
 
     # ------------------------------ window ----------------------------- #
 
@@ -478,9 +508,9 @@ class FleetMonitor:
             # steady-state.
             self._last_bucket = bucket
             self._acc = np.zeros(N_FLEET_SIGNALS, np.float64)
-            return {k: None for k in FLEET_EVENT_FIELDS}
+            return {k: None for k in self._event_keys}
         if bucket <= self._last_bucket:
-            return {k: None for k in FLEET_EVENT_FIELDS}
+            return {k: None for k in self._event_keys}
         self._last_bucket = bucket
         return self._close_window()
 
@@ -542,7 +572,51 @@ class FleetMonitor:
         del self._straggler_events[:-_RECENT_STRAGGLERS_MAX]
         self.registry.counter("fleet/anomalies_total").inc()
         self._pending_straggler = event
+        self._maybe_rebalance(event)
         self._self_apply(event)
+
+    def attach_rebalancer(self, rebalancer) -> None:
+        """Attach the run's input-rebalance actuator (ISSUE 14; called by
+        ``Stoke.DataLoader`` when ``FleetConfig.rebalance`` is on).  The
+        monitor only PROPOSES share shifts — the rebalancer owns the
+        bounded shares and the agreement protocol that makes every host
+        apply them at the same fetch index."""
+        self.rebalancer = rebalancer
+
+    def _maybe_rebalance(self, event: Dict[str, Any]) -> None:
+        """Act on a completed loader-classified straggler streak (the
+        K-window hysteresis IS the actuation gate): shift
+        ``rebalance_rows`` of per-slice read work from the flagged host to
+        the host with the least loader wait this window.  Every host runs
+        this on the IDENTICAL exchanged matrix, so the decision — and the
+        share state it evolves — is deterministic fleet-wide without any
+        extra collective."""
+        rb = self.rebalancer
+        if (
+            rb is None
+            or not self._rebalance_on
+            or self.n_processes <= 1
+            or event.get("skew_class") != "loader"
+            or self.last_matrix is None
+        ):
+            return
+        slow = int(event["host"])
+        loader_col = self.last_matrix[:, FLEET_INDEX["loader_wait_s"]]
+        fast = int(loader_col.argmin())
+        if fast == slow:
+            return
+        moved = rb.propose_shift(
+            slow, fast, int(getattr(self.cfg, "rebalance_rows", 1))
+        )
+        if not moved:
+            return  # bound reached: the share floor/ceiling holds
+        self._last_shift = {"rows": moved, "from": slow, "to": fast}
+        self.registry.counter("fleet/rebalance_shifts_total").inc()
+        self.registry.counter("fleet/rebalance_rows_moved_total").inc(moved)
+        self.registry.gauge(
+            "fleet/rebalance_share_self",
+            help="this host's per-slice read share (rows)",
+        ).set(float(rb.share_of(self.rank)))
 
     def _self_apply(self, event: Dict[str, Any]) -> None:
         """Warn-path fallback when no health registry will consume the
@@ -579,6 +653,33 @@ class FleetMonitor:
 
     def _event_fields(self, verdict: Dict[str, Any]) -> Dict[str, Any]:
         flagged = verdict["flagged"]
+        out = self._base_event_fields(verdict, flagged)
+        if self._rebalance_on:
+            rb = self.rebalancer
+            shift = self._last_shift
+            self._last_shift = None  # report each actuation exactly once
+            out.update({
+                "fleet/rebalance_share_self": (
+                    None if rb is None else float(rb.share_of(self.rank))
+                ),
+                "fleet/rebalance_shift_rows": (
+                    None if shift is None else shift["rows"]
+                ),
+                "fleet/rebalance_from_host": (
+                    None if shift is None else shift["from"]
+                ),
+                "fleet/rebalance_to_host": (
+                    None if shift is None else shift["to"]
+                ),
+                "fleet/rebalance_shifts": (
+                    None if rb is None else float(rb.shifts)
+                ),
+            })
+        return out
+
+    def _base_event_fields(
+        self, verdict: Dict[str, Any], flagged: bool
+    ) -> Dict[str, Any]:
         return {
             "fleet/hosts": verdict["hosts"],
             "fleet/window": self.windows,
@@ -629,6 +730,21 @@ class FleetMonitor:
         out["straggler_anomalies"] = int(
             self.registry.counter("fleet/anomalies_total").value
         )
+        if self._rebalance_on:
+            rb = self.rebalancer
+            out["rebalance"] = {
+                "shifts": int(
+                    self.registry.counter(
+                        "fleet/rebalance_shifts_total"
+                    ).value
+                ),
+                "rows_moved": int(
+                    self.registry.counter(
+                        "fleet/rebalance_rows_moved_total"
+                    ).value
+                ),
+                "shares": None if rb is None else list(rb.shares),
+            }
         return out
 
 

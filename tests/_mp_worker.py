@@ -417,6 +417,104 @@ def main():
                 "straggler_events": summary["straggler_events"],
             }, f, default=repr)
 
+    elif SCENARIO == "rebalance":
+        # skew-reactive input rebalancing (ISSUE 14 acceptance): worker
+        # 1's dataset sleeps per item read.  The fleet verdict classifies
+        # it loader-bound, the K=2 streak completes, and the actuator
+        # shifts read rows off host 1 — after which host 1's loader wait
+        # (and the fleet lag fraction) drops.  Each worker also proves the
+        # device feed is UNCHANGED: the rows its devices received each
+        # step are exactly the sampler's canonical per-rank plan, shifted
+        # reads and the exchange notwithstanding.
+        import time
+
+        from stoke_tpu import FleetConfig, TelemetryConfig
+        from stoke_tpu.data import BucketedDistributedSampler
+
+        N_ROWS, BATCH_STEPS, SLEEP_S = 512, 16, 0.01
+
+        class _IdRows:
+            """Row i carries its index in x[i, 0]; host 1 sleeps per
+            read, modeling a slow input pipeline."""
+
+            def __init__(self, sleep_s):
+                self.x = np.zeros((N_ROWS, IN), np.float32)
+                self.x[:, 0] = np.arange(N_ROWS, dtype=np.float32)
+                self.y = np.zeros((N_ROWS, OUT), np.float32)
+                self.sleep_s = sleep_s
+
+            def __len__(self):
+                return N_ROWS
+
+            def __getitem__(self, i):
+                if self.sleep_s:
+                    time.sleep(self.sleep_s)
+                return self.x[i], self.y[i]
+
+        out_dir = os.path.join(TMP, "telemetry")
+        s = make_stoke(extra_configs=[
+            TelemetryConfig(
+                output_dir=out_dir,
+                log_every_n_steps=1,
+                jsonl_all_ranks=True,
+                prometheus=False,
+                sample_device_time=False,
+            ),
+            FleetConfig(
+                window_steps=1,
+                straggler_rel_frac=0.1,
+                straggler_windows=2,
+                straggler_action="record",
+                rebalance=True,
+                rebalance_rows=4,
+                rebalance_max_frac=0.5,
+            ),
+        ])
+        data = _IdRows(SLEEP_S if PID == 1 else 0.0)
+        sampler = BucketedDistributedSampler(
+            data, buckets=1, batch_size=16,
+            sorted_idx=list(range(N_ROWS)),
+            num_replicas=NPROC, rank=PID, info_rank=0,
+        )
+        loader = s.DataLoader(data, sampler=sampler)
+        rb = s.fleet.rebalancer
+        assert rb is not None, "facade did not attach the rebalancer"
+        # the canonical per-rank plan the device feed must keep matching
+        expected = [b[PID] for b in sampler.global_batches()]
+        steps, fed_ok = 0, True
+        import warnings as _warnings
+
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore")
+            for x, y in loader:
+                # this host's addressable rows ARE its canonical batch
+                local = np.concatenate([
+                    np.asarray(sh.data)[:, 0]
+                    for sh in x.addressable_shards
+                ])
+                want = np.asarray(
+                    [float(i) for i in expected[steps]], np.float32
+                )
+                fed_ok = fed_ok and np.array_equal(np.sort(local),
+                                                   np.sort(want))
+                s.train_step(x, (y,))
+                steps += 1
+                if steps >= BATCH_STEPS:
+                    break
+        assert steps == BATCH_STEPS, steps
+        assert fed_ok, "device feed diverged from the canonical plan"
+        shares = list(rb.shares)
+        s.close_telemetry()
+        with open(os.path.join(TMP, f"rebalance_result_p{PID}.json"),
+                  "w") as f:
+            json.dump({
+                "shares": shares,
+                "shifts": rb.shifts,
+                "rows_moved": rb.rows_moved,
+                "fed_ok": bool(fed_ok),
+                "summary": (s.fleet_summary or {}).get("rebalance"),
+            }, f, default=repr)
+
     elif SCENARIO == "loader":
         # multi-process DataLoader REQUIRES a distributed sampler
         # (reference stoke.py:822-826); with one, processes see disjoint

@@ -28,13 +28,24 @@ def main() -> int:
                     help="workdir: checkpoints + trajectory + final params")
     ap.add_argument("--steps", type=int, default=6)
     ap.add_argument("--resilience", action="store_true")
+    ap.add_argument("--offload-saves", type=int, default=None,
+                    help="periodic OFFLOAD-STAGED async saves every N "
+                    "steps (ISSUE 14; the kill_during_save chaos target)")
     args = ap.parse_args()
 
     import optax
 
-    from stoke_tpu import ResilienceConfig, Stoke, StokeOptimizer
+    from stoke_tpu import CheckpointConfig, ResilienceConfig, Stoke, \
+        StokeOptimizer
 
     configs = []
+    if args.offload_saves:
+        configs.append(CheckpointConfig(
+            async_save=True,
+            offload_staging=True,
+            save_every_n_steps=args.offload_saves,
+            auto_path=os.path.join(args.root, "auto"),
+        ))
     if args.resilience:
         configs.append(ResilienceConfig(
             save_path=os.path.join(args.root, "ckpts"),
@@ -73,6 +84,8 @@ def main() -> int:
             }) + "\n")
             f.flush()
 
+    if args.offload_saves:
+        stoke.wait_for_checkpoint()
     np.save(
         os.path.join(args.root, "final_w.npy"),
         np.asarray(stoke.params["w"]),

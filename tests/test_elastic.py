@@ -1,0 +1,881 @@
+"""Elastic resilience tests (ISSUE 14): zero-stall offload-staged saves,
+topology-elastic resume (+ the residual partition algebra), descriptor
+quarantine, skew-reactive input rebalancing, and the kill_during_save
+chaos injector.
+
+All CPU-only and deterministic on the 8-device simulated mesh (conftest).
+The elastic-resume acceptance saves on the 8-device mesh under one
+(tier, mesh) config and resumes on a 4-device mesh under another —
+restored params bit-identical, sharded EF residual and opt state
+re-partitioned to the new layout, resumed loss trajectory matching an
+uninterrupted reference within tolerance.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from stoke_tpu import (
+    CheckpointConfig,
+    CommConfig,
+    FleetConfig,
+    MeshConfig,
+    PreemptedError,
+    ResilienceConfig,
+    Stoke,
+    StokeOptimizer,
+    StokeValidationError,
+    TelemetryConfig,
+)
+from stoke_tpu import io_ops, offload
+from stoke_tpu.data import (
+    BucketedDistributedSampler,
+    InputRebalancer,
+    assemble_rebalanced_batch,
+    reassemble_from_gathered,
+)
+from stoke_tpu.parallel.zero import (
+    flat_to_residual,
+    remap_residual,
+    residual_to_flat,
+)
+from stoke_tpu.resilience import parse_chaos, verify_checkpoint
+
+pytestmark = pytest.mark.elastic
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "scripts"))
+
+IN, OUT = 16, 8
+
+
+def _make_stoke(tmp_path, *, tag="run", devices=None, comm=False,
+                sddp=False, bpd=4, ckpt=None, telemetry=False,
+                extra=(), model_out=OUT):
+    cfgs = [ResilienceConfig(
+        save_path=str(tmp_path / tag / "em"), exit_on_preempt=False,
+    )]
+    if telemetry:
+        cfgs.append(TelemetryConfig(
+            output_dir=str(tmp_path / tag / "telemetry"),
+            log_every_n_steps=1, sample_device_time=False,
+            prometheus=False,
+        ))
+    if comm:
+        cfgs.append(CommConfig(dtype="int8", stochastic_rounding=False))
+    if sddp:
+        from stoke_tpu import OSSConfig, SDDPConfig
+
+        # shard even the tiny test leaves (defaults replicate < 1k elems)
+        cfgs.append(OSSConfig(min_shard_size=1))
+        cfgs.append(SDDPConfig(min_shard_size=1))
+    if devices is not None:
+        cfgs.append(MeshConfig(devices=np.array(devices)))
+    if ckpt is not None:
+        cfgs.append(ckpt)
+    cfgs.extend(extra)
+    return Stoke(
+        model=lambda p, x: x @ p["w1"] @ p["w2"],
+        optimizer=StokeOptimizer(
+            optimizer=optax.sgd,
+            # momentum: the opt state carries per-param trace leaves, so
+            # the elastic-resume test can assert their re-sharded layout
+            optimizer_kwargs={"learning_rate": 0.05, "momentum": 0.9},
+        ),
+        loss=lambda o, y: ((o - y) ** 2).mean(),
+        params={
+            "w1": np.ones((IN, IN), np.float32) * 0.1,
+            "w2": np.ones((IN, model_out), np.float32) * 0.1,
+        },
+        batch_size_per_device=bpd,
+        distributed="dp",
+        oss=sddp,
+        sddp=sddp,
+        configs=cfgs,
+        verbose=False,
+    )
+
+
+def _batches(n, global_batch=32, seed=3):
+    rng = np.random.default_rng(seed)
+    W = rng.normal(size=(IN, OUT)).astype(np.float32)
+    out = []
+    for _ in range(n):
+        x = rng.normal(size=(global_batch, IN)).astype(np.float32)
+        out.append((x, (x @ W).astype(np.float32)))
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# staging copier (offload.py)
+# --------------------------------------------------------------------------- #
+
+
+def test_staged_snapshot_survives_donation(devices):
+    """The decoupling copy makes staged values independent of the source
+    buffers — donating (deleting) the source after stage() must not
+    corrupt the resolved host values."""
+    import functools
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(devices), ("data",))
+    sh = NamedSharding(mesh, P("data"))
+    x = jax.device_put(
+        jnp.arange(64, dtype=jnp.float32).reshape(8, 8), sh
+    )
+    snap = offload.stage_tree({"a": x, "b": 7})
+
+    @functools.partial(jax.jit, donate_argnums=0, out_shardings=sh)
+    def clobber(a):
+        return a * 0 - 1.0
+
+    clobber(x).block_until_ready()
+    treedef, records = snap.resolve()
+    kinds = [k for k, _ in records]
+    assert kinds == ["array", "static"]
+    shape, dtype, shards = records[0][1]
+    assert shape == (8, 8) and dtype == np.float32
+    got = np.zeros(shape, np.float32)
+    for key, arr, shard_shape in shards:
+        sl = tuple(slice(s, e, st) for s, e, st in key)
+        got[sl] = arr.reshape(shard_shape)
+    assert np.array_equal(
+        got, np.arange(64, dtype=np.float32).reshape(8, 8)
+    )
+
+
+def test_stage_double_buffer_bound(devices):
+    """A third in-flight snapshot drains the oldest first (bounded HBM /
+    host memory), and drain_staged() resolves everything."""
+    import jax.numpy as jnp
+
+    x = jnp.ones((32,), jnp.float32)
+    s1 = offload.stage_tree({"x": x})
+    s2 = offload.stage_tree({"x": x})
+    assert not s1.resolved and not s2.resolved
+    s3 = offload.stage_tree({"x": x})
+    assert s1.resolved  # oldest auto-drained by the double buffer
+    assert not s3.resolved
+    offload.drain_staged()
+    assert s2.resolved and s3.resolved
+    # idempotent + still returns the cached records
+    _, records = s3.resolve()
+    assert records[0][0] == "array"
+
+
+# --------------------------------------------------------------------------- #
+# zero-stall staged saves (io_ops)
+# --------------------------------------------------------------------------- #
+
+_STAGED_CKPT = CheckpointConfig(async_save=True, offload_staging=True)
+
+
+def test_staged_save_no_main_thread_gather(tmp_path, monkeypatch):
+    """The offload-staged async save never runs the blocking gather —
+    and the written checkpoint is manifest-complete and loads
+    bit-identically (onto the same topology here)."""
+    s = _make_stoke(tmp_path, ckpt=_STAGED_CKPT)
+    for x, y in _batches(2):
+        s.train_step(x, (y,))
+
+    def _no_gather(tree):
+        raise AssertionError(
+            "staged save must not gather on the main thread"
+        )
+
+    monkeypatch.setattr(io_ops, "_gather_to_host", _no_gather)
+    tag_dir = s.save(str(tmp_path / "ck"))
+    s.wait_for_checkpoint()
+    monkeypatch.undo()
+    ok, reason = verify_checkpoint(tag_dir)
+    assert ok, reason
+    assert os.path.exists(
+        os.path.join(tag_dir, "variables.staged.rank0.npz")
+    )
+    with open(os.path.join(tag_dir, "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["staged"]["processes"] == 1
+    assert "variables" in meta["staged"]["keys"]
+    w_ref = {k: np.asarray(v) for k, v in s.params.items()}
+    s2 = _make_stoke(tmp_path, tag="load", ckpt=_STAGED_CKPT)
+    s2.load(str(tmp_path / "ck"))
+    for k, ref in w_ref.items():
+        assert np.array_equal(np.asarray(s2.params[k]), ref), k
+    assert s2.optimizer_steps == 2
+
+
+def test_staged_partial_tag_detected_and_quarantined(tmp_path):
+    """A staged tag missing one shard file is a partial write: the
+    validator names it and resume quarantines instead of loading."""
+    s = _make_stoke(tmp_path, ckpt=_STAGED_CKPT)
+    x, y = _batches(1)[0]
+    s.train_step(x, (y,))
+    root = str(tmp_path / "ck")
+    tag_dir = s.save(root)
+    s.wait_for_checkpoint()
+    os.remove(os.path.join(tag_dir, "opt_state.staged.rank0.npz"))
+    ok, reason = verify_checkpoint(tag_dir)
+    assert not ok and "staged payload incomplete" in reason
+    s2 = _make_stoke(tmp_path, tag="resume")
+    assert s2.resume(path=root) is False
+    qdir = os.path.join(root, "quarantine")
+    assert os.path.isdir(qdir) and os.listdir(qdir)
+    assert (s2.resilience_summary or {})["quarantined_ckpts"] == 1
+
+
+def test_wait_for_saves_drains_staging_before_emergency_gather(
+    tmp_path, monkeypatch
+):
+    """The preemption-boundary race (ISSUE 14 satellite): an emergency
+    save arriving while an offload-staged periodic save is mid-flight
+    must drain the staging buffers BEFORE its synchronous gather — the
+    ordering is pinned by an event log, not by luck."""
+    events = []
+    real_resolve = offload.StagedSnapshot.resolve
+
+    def slow_resolve(self):
+        time.sleep(0.05)  # keep the staged save genuinely mid-flight
+        out = real_resolve(self)
+        events.append("staged-resolved")
+        return out
+
+    real_gather = io_ops._gather_to_host
+
+    def logged_gather(tree):
+        events.append("gather")
+        return real_gather(tree)
+
+    monkeypatch.setattr(offload.StagedSnapshot, "resolve", slow_resolve)
+    monkeypatch.setattr(io_ops, "_gather_to_host", logged_gather)
+    s = _make_stoke(tmp_path, ckpt=_STAGED_CKPT)
+    batches = _batches(3)
+    x, y = batches[0]
+    s.train_step(x, (y,))
+    s.save(str(tmp_path / "ck"))  # staged async save, still in flight
+    s.resilience.request_preemption("race")
+    with pytest.raises(PreemptedError):
+        x, y = batches[1]
+        s.train_step(x, (y,))
+    assert "gather" in events and "staged-resolved" in events
+    first_gather = events.index("gather")
+    assert all(
+        e == "staged-resolved" for e in events[:first_gather]
+    ) and first_gather >= 1, events
+    # both checkpoints are complete and valid
+    s.wait_for_checkpoint()
+    for root in (tmp_path / "ck", tmp_path / "run" / "em"):
+        tags = [t for t in os.listdir(root) if t.startswith("stoke-")]
+        assert tags, root
+        for t in tags:
+            ok, reason = verify_checkpoint(os.path.join(str(root), t))
+            assert ok, (t, reason)
+
+
+def test_manifest_skips_inflight_tmp_files(tmp_path):
+    """Manifests never digest ``*.tmp`` names: with multi-rank staged
+    writes, rank 0's manifest runs while peers' tmp+rename writes are in
+    flight — listing a transient name would permanently fail verification
+    of a healthy checkpoint once the rename retires it."""
+    from stoke_tpu.resilience import read_manifest, write_manifest
+
+    tag = tmp_path / "stoke-x-backward-step-1"
+    tag.mkdir()
+    (tag / "meta.json").write_text('{"format": "consolidated"}')
+    (tag / "variables.staged.rank0.npz").write_bytes(b"done")
+    (tag / "variables.staged.rank1.npz.tmp").write_bytes(b"inflight")
+    write_manifest(str(tag))
+    listed = read_manifest(str(tag))["files"]
+    assert "variables.staged.rank0.npz" in listed
+    assert not any(".tmp" in name for name in listed)
+    # the in-flight write completing afterwards must not break digests
+    os.replace(
+        tag / "variables.staged.rank1.npz.tmp",
+        tag / "variables.staged.rank1.npz",
+    )
+    ok, reason = verify_checkpoint(str(tag))
+    assert ok, reason
+
+
+def test_durable_save_accounting_per_save(tmp_path):
+    """_last_save_step advances per save WHEN ITS WRITE LANDS: an older
+    completed async save stays counted even while a newer one is pending
+    (the review's single-slot overwrite hazard)."""
+    s = _make_stoke(tmp_path, ckpt=_STAGED_CKPT)
+    x, y = _batches(1)[0]
+    s.train_step(x, (y,))
+    assert s._last_save_step == 0
+    s.save(str(tmp_path / "ck"))
+    s.wait_for_checkpoint()  # bg thread ran on_durable
+    assert s._last_save_step == 1
+    s.train_step(x, (y,))
+    # a sync save promotes on return
+    s._save_with_config(
+        str(tmp_path / "ck"), "sync", CheckpointConfig(), None
+    )
+    assert s._last_save_step == 2
+
+
+def test_offload_staging_status_rules(tmp_path):
+    """offload_staging without async_save (or with the sharded format) is
+    a status error naming the remedy; the YAML builder accepts the new
+    knobs."""
+    with pytest.raises(StokeValidationError, match="async_save"):
+        _make_stoke(
+            tmp_path,
+            ckpt=CheckpointConfig(offload_staging=True),
+        )
+    from stoke_tpu import CheckpointFormat
+
+    with pytest.raises(StokeValidationError, match="consolidated"):
+        _make_stoke(
+            tmp_path,
+            ckpt=CheckpointConfig(
+                offload_staging=True, async_save=True,
+                format=CheckpointFormat.sharded,
+            ),
+        )
+    from stoke_tpu.utils.yaml_config import _build_config_object
+
+    ck = _build_config_object(
+        "CheckpointConfig",
+        {"async_save": True, "offload_staging": True},
+    )
+    assert ck.offload_staging is True
+    fl = _build_config_object(
+        "FleetConfig",
+        {"rebalance": True, "rebalance_rows": 2,
+         "rebalance_max_frac": 0.5},
+    )
+    assert fl.rebalance is True and fl.rebalance_rows == 2
+
+
+def test_rebalance_status_rules(tmp_path):
+    with pytest.raises(StokeValidationError, match="rebalance_rows"):
+        _make_stoke(tmp_path, telemetry=True, extra=[
+            FleetConfig(rebalance=True, rebalance_rows=0),
+        ])
+    with pytest.raises(StokeValidationError, match="rebalance_max_frac"):
+        _make_stoke(tmp_path, telemetry=True, extra=[
+            FleetConfig(rebalance=True, rebalance_max_frac=1.5),
+        ])
+
+
+# --------------------------------------------------------------------------- #
+# residual partition algebra (zero.py)
+# --------------------------------------------------------------------------- #
+
+
+def _sharded_desc(leaf_sizes, world, chunk=64, bucket_elems=10_000):
+    """A sharded layout descriptor with the transport's padding rule
+    (align = world × chunk)."""
+    total = sum(leaf_sizes)
+    align = world * chunk
+    padded = -(-total // align) * align
+    return {
+        "kind": "sharded", "world": world, "error_feedback": True,
+        "leaf_sizes": list(leaf_sizes), "buckets": [[total, padded]],
+    }
+
+
+def test_residual_remap_world_change_roundtrip():
+    sizes = [200, 56]
+    rng = np.random.default_rng(0)
+    flat = rng.normal(size=(sum(sizes),)).astype(np.float32)
+    d8 = _sharded_desc(sizes, 8)
+    d4 = _sharded_desc(sizes, 4)
+    res8 = flat_to_residual(flat, d8, None)
+    assert res8[0].shape == (d8["buckets"][0][1],)
+    res4 = remap_residual(res8, d8, d4, None)
+    assert res4[0].shape == (d4["buckets"][0][1],)
+    assert np.array_equal(residual_to_flat(res4, d4), flat)
+    # and back up to 8 — lossless both directions
+    back = remap_residual(res4, d4, d8, None)
+    assert np.array_equal(residual_to_flat(back, d8), flat)
+
+
+def test_residual_remap_replicated_sharded_conversion():
+    template = {
+        "a": np.zeros((10, 2), np.float32),
+        "b": np.zeros((5,), np.float32),
+    }
+    sizes = [20, 5]
+    rng = np.random.default_rng(1)
+    leaves = {
+        "a": rng.normal(size=(10, 2)).astype(np.float32),
+        "b": rng.normal(size=(5,)).astype(np.float32),
+    }
+    repl_desc = {
+        "kind": "replicated", "world": 8, "error_feedback": True,
+        "leaf_sizes": sizes, "buckets": [[25, 512]],
+    }
+    sh_desc = _sharded_desc(sizes, 4, chunk=16)
+    sh = remap_residual(leaves, repl_desc, sh_desc, None)
+    flat = residual_to_flat(sh, sh_desc)
+    # back to the replicated per-leaf packing
+    repl = flat_to_residual(flat, repl_desc, template)
+    assert np.array_equal(repl["a"], leaves["a"])
+    assert np.array_equal(repl["b"], leaves["b"])
+
+
+def test_residual_remap_model_mismatch_raises():
+    d_a = _sharded_desc([100], 4)
+    d_b = _sharded_desc([120], 4)
+    res = flat_to_residual(np.zeros(100, np.float32), d_a, None)
+    with pytest.raises(ValueError, match="incompatible"):
+        remap_residual(res, d_a, d_b, None)
+
+
+# --------------------------------------------------------------------------- #
+# topology-elastic resume (the acceptance)
+# --------------------------------------------------------------------------- #
+
+
+def test_elastic_resume_8dev_to_4dev_acceptance(tmp_path, devices):
+    """Save on the 8-device mesh (sddp tier, int8 sharded-EF transport),
+    resume on a 4-device mesh: params bit-identical after re-shard, opt
+    state + sharded EF residual re-partitioned to the new layout
+    (leaf-shape-asserted), elastic accounting ticks, and the resumed
+    trajectory tracks an uninterrupted 8-device reference within
+    tolerance at EQUAL global batch."""
+    batches = _batches(8, global_batch=32)
+
+    # uninterrupted reference on the 8-device mesh
+    ref = _make_stoke(tmp_path, tag="ref", comm=True, sddp=True, bpd=4)
+    for x, y in batches:
+        ref.train_step(x, (y,))
+    ref_losses = float(ref.ema_loss)
+
+    # preempted run: 4 steps on 8 devices, emergency save at the boundary
+    s = _make_stoke(tmp_path, tag="run", comm=True, sddp=True, bpd=4)
+    for x, y in batches[:3]:
+        s.train_step(x, (y,))
+    s.resilience.request_preemption("elastic")
+    with pytest.raises(PreemptedError):
+        x, y = batches[3]
+        s.train_step(x, (y,))
+    saved_params = {k: np.asarray(v) for k, v in s.params.items()}
+    saved_res = s._comm_state["residual"]
+    assert saved_res[0].shape[0] % 8 == 0
+
+    # resume on a 4-DEVICE mesh (same emergency root), equal global batch
+    half = _make_stoke(
+        tmp_path, tag="run", devices=devices[:4], comm=True, sddp=True,
+        bpd=8,
+    )
+    assert half._mesh.size == 4
+    assert half.resume() is True
+    assert half.optimizer_steps == 4
+    # params bit-identical after the re-shard
+    for k, ref_w in saved_params.items():
+        assert np.array_equal(np.asarray(half.params[k]), ref_w), k
+    # the sharded EF residual re-partitioned: padding re-aligned for
+    # world 4, values preserved
+    res4 = half._comm_state["residual"]
+    desc8 = s._engine.transport.layout_descriptor(s._variables["params"])
+    desc4 = half._engine.transport.layout_descriptor(
+        half._variables["params"]
+    )
+    assert desc8 != desc4  # the re-map was real
+    assert res4[0].shape == (desc4["buckets"][0][1],)
+    assert np.array_equal(
+        residual_to_flat(
+            [np.asarray(b) for b in res4], desc4
+        ),
+        residual_to_flat(
+            [np.asarray(b) for b in saved_res], desc8
+        ),
+    )
+    # opt state lives on the 4-device layout (sddp shards over the axis)
+    from jax.sharding import PartitionSpec as P
+
+    opt_leaves = jax.tree_util.tree_leaves(half._opt_state)
+    assert all(
+        set(l.sharding.mesh.devices.flat) <= set(devices[:4])
+        for l in opt_leaves if isinstance(l, jax.Array)
+    )
+    sharded_leaves = [
+        l for l in opt_leaves
+        if isinstance(l, jax.Array)
+        and l.sharding.spec != P()
+        and l.ndim
+    ]
+    assert sharded_leaves, "sddp opt state should shard over the axis"
+    # elastic accounting
+    rz = half.resilience_summary
+    assert rz["elastic_resumes"] == 1
+    assert rz["elastic_resume"]["from"]["mesh_shape"] == [8]
+    assert rz["elastic_resume"]["to"]["mesh_shape"] == [4]
+    assert half.resilience.event_fields()[
+        "resilience/elastic_resumes"
+    ] == 1.0
+    # resumed trajectory tracks the uninterrupted reference (equal global
+    # batch; fp32 reduction order differs across meshes → tolerance)
+    for x, y in batches[4:]:
+        half.train_step(x, (y,))
+    assert half.optimizer_steps == 8
+    assert np.isclose(float(half.ema_loss), ref_losses, rtol=5e-2), (
+        float(half.ema_loss), ref_losses,
+    )
+
+
+def test_incompatible_descriptor_quarantined_with_remedy(tmp_path):
+    """A digest-clean checkpoint saved by a DIFFERENT model quarantines
+    at resume with a remedy-naming reason — never a crash mid-restore."""
+    s = _make_stoke(tmp_path, tag="a", model_out=OUT)
+    x, y = _batches(1)[0]
+    s.train_step(x, (y,))
+    root = str(tmp_path / "ck")
+    s.save(root)
+    other = _make_stoke(tmp_path, tag="b", model_out=OUT + 2)
+    assert other.resume(path=root) is False
+    qdir = os.path.join(root, "quarantine")
+    assert os.path.isdir(qdir)
+    (qtag,) = os.listdir(qdir)
+    with open(os.path.join(qdir, qtag, "QUARANTINED.json")) as f:
+        record = json.load(f)
+    assert "incompatible checkpoint" in record["reason"]
+    assert "resume with the saving architecture" in record["reason"]
+    assert (other.resilience_summary or {})["quarantined_ckpts"] == 1
+
+
+def test_topology_descriptor_contents(tmp_path):
+    s = _make_stoke(tmp_path, comm=True, sddp=True)
+    desc = s.topology_descriptor()
+    assert desc["mesh_shape"] == [8]
+    assert desc["tier"] == "sddp"
+    assert desc["shard_updates"] is True
+    assert desc["param_leaves"] == 2
+    assert desc["param_elems"] == IN * IN + IN * OUT
+    assert desc["comm"]["kind"] == "sharded"
+    # topology-only differences are NOT incompatibility
+    assert s._descriptor_incompatible(
+        {**desc, "mesh_shape": [4], "device_count": 4}
+    ) is None
+    assert "incompatible" in s._descriptor_incompatible(
+        {**desc, "param_elems": 123}
+    )
+    assert s._topology_changed({**desc, "tier": "oss"}, desc)
+    assert not s._topology_changed(desc, desc)
+    assert not s._topology_changed(None, desc)
+
+
+# --------------------------------------------------------------------------- #
+# chaos: kill_during_save
+# --------------------------------------------------------------------------- #
+
+
+def test_parse_chaos_kill_during_save():
+    spec = parse_chaos("kill_during_save=2")
+    assert spec.kill_during_save == 2 and spec.active
+    with pytest.raises(ValueError, match="kill_during_save"):
+        parse_chaos("kill_during_save=0")
+
+
+def test_kill_during_save_leaves_quarantinable_partial(tmp_path):
+    """SIGKILL from inside an async offload save's background writer
+    (after payload, before meta.json): the worker dies -9, the tag reads
+    as a partial write, and a resuming run quarantines it — never
+    resumes from it."""
+    root = str(tmp_path / "work")
+    os.makedirs(root)
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "PYTHONPATH": _REPO,
+        "STOKE_CHAOS": "kill_during_save=1",
+    }
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "tests", "_resilience_worker.py"),
+         "--root", root, "--steps", "4", "--resilience",
+         "--offload-saves", "2"],
+        env=env, capture_output=True, text=True, timeout=180,
+    )
+    assert proc.returncode == -9, (proc.returncode, proc.stderr[-2000:])
+    auto = os.path.join(root, "auto")
+    tags = [t for t in os.listdir(auto) if t.startswith("stoke-")]
+    assert tags, os.listdir(auto)
+    for t in tags:
+        ok, reason = verify_checkpoint(os.path.join(auto, t))
+        assert not ok, (t, reason)
+    # a resuming run must quarantine the half-staged tag, not load it
+    import optax as _optax
+
+    resumer = Stoke(
+        model=lambda p, x: x @ p["w"],
+        optimizer=StokeOptimizer(
+            optimizer=_optax.sgd, optimizer_kwargs={"learning_rate": 0.05}
+        ),
+        loss=lambda o, y: ((o - y) ** 2).mean(),
+        params={"w": np.ones((8, 4), np.float32) * 0.1},
+        batch_size_per_device=4,
+        configs=[ResilienceConfig(
+            save_path=os.path.join(root, "ckpts"),
+            exit_on_preempt=False,
+        )],
+        verbose=False,
+    )
+    assert resumer.resume(path=auto, name="auto") is False
+    qdir = os.path.join(auto, "quarantine")
+    assert os.path.isdir(qdir) and os.listdir(qdir)
+
+
+# --------------------------------------------------------------------------- #
+# run_resilient restart-cost columns
+# --------------------------------------------------------------------------- #
+
+
+def test_run_resilient_records_elapsed_and_lost_goodput(tmp_path):
+    import run_resilient as rr
+
+    bundle = tmp_path / "bundle"
+    bundle.mkdir()
+    with open(bundle / "manifest.json", "w") as f:
+        json.dump({"extra": {
+            "step_ema_s": 0.25, "lost_steps_estimate": 8,
+        }}, f)
+
+    calls = []
+
+    def fake_run(argv, env):
+        calls.append(env)
+        if len(calls) == 1:  # only the dying attempt writes a bundle
+            with open(env[rr.BUNDLE_FILE_ENV], "w") as f:
+                f.write(str(bundle) + "\n")
+        return 114 if len(calls) == 1 else 0
+
+    outcome = rr.run_resilient(
+        ["worker"], max_restarts=2, seed=0, run=fake_run,
+        sleep=lambda s: None,
+    )
+    assert outcome["ok"] and outcome["attempts"] == 2
+    first = outcome["records"][0]
+    assert first["exit_code"] == 114
+    assert "elapsed_s" in first and first["elapsed_s"] >= 0
+    assert first["lost_steps_estimate"] == 8
+    assert first["step_ema_s"] == 0.25
+    assert first["lost_goodput_s_est"] == pytest.approx(2.0)
+    # a clean attempt with no bundle carries the wall clock only
+    second = outcome["records"][1]
+    assert "elapsed_s" in second
+    assert "lost_goodput_s_est" not in second
+
+
+# --------------------------------------------------------------------------- #
+# skew-reactive input rebalancing
+# --------------------------------------------------------------------------- #
+
+
+def test_rebalancer_bounds_and_apply_protocol():
+    rb = InputRebalancer(
+        n_hosts=2, rank=0, batch_size=16, max_frac=0.25, apply_slack=3
+    )
+    assert rb.shares == [16, 16] and not rb.shifted
+    # bounded step: max_shift = 4 rows
+    assert rb.propose_shift(1, 0, 10) == 4
+    assert rb.share_of(1) == 12 and rb.share_of(0) == 20
+    # the bound binds: nothing more to move
+    assert rb.propose_shift(1, 0, 10) == 0
+    assert rb.shifts == 1 and rb.rows_moved == 4
+    # shares apply only past the agreed fetch index (yields=0 → eff=3)
+    assert rb.shares_for_fetch() == [16, 16]  # fetch 0
+    assert rb.shares_for_fetch() == [16, 16]  # fetch 1
+    assert rb.shares_for_fetch() == [16, 16]  # fetch 2
+    assert rb.shares_for_fetch() == [20, 12]  # fetch 3 = eff
+    assert rb.shifted
+    # no-op proposals
+    assert rb.propose_shift(0, 0, 2) == 0
+    assert rb.propose_shift(1, 0, 0) == 0
+
+
+def test_rebalanced_batches_identical_to_canonical(devices):
+    """The acceptance's conservation half, simulated fleet-of-two: with
+    ANY legal share split, every host's assembled batch is bit-identical
+    to its canonical batch — the device feed and per-epoch sample set
+    cannot change, only who read the rows."""
+    n_rows, batch = 128, 8
+
+    class _IdRows:
+        def __len__(self):
+            return n_rows
+
+        def __getitem__(self, i):
+            return (
+                np.full((4,), i, np.float32),
+                np.float32(i),
+            )
+
+    data = _IdRows()
+    samplers = [
+        BucketedDistributedSampler(
+            data, buckets=1, batch_size=batch,
+            sorted_idx=list(range(n_rows)),
+            num_replicas=2, rank=r, info_rank=0, seed=5,
+        )
+        for r in range(2)
+    ]
+    plans = [s.global_batches() for s in samplers]
+    assert plans[0] == plans[1]  # replicas derive the identical plan
+
+    def assemble(idx):
+        xs = np.stack([data[int(i)][0] for i in idx])
+        ys = np.stack([np.asarray(data[int(i)][1]) for i in idx])
+        return xs, ys
+
+    for shares in ([8, 8], [10, 6], [4, 12], [15, 1]):
+        for b, per_replica in enumerate(plans[0][:4]):
+            # the exchange payload each host would contribute
+            canonical = [i for sub in per_replica for i in sub]
+            cuts = np.concatenate([[0], np.cumsum(shares)])
+            payloads = []
+            from stoke_tpu.data import _pad_rows
+
+            for r in range(2):
+                mine = canonical[cuts[r]:cuts[r + 1]]
+                # the exchange pads to the LARGEST share, not the slice
+                payloads.append(
+                    _pad_rows(assemble(mine), int(max(shares)))
+                )
+
+            def fake_allgather(_payload):
+                return (
+                    np.stack([p[0] for p in payloads]),
+                    np.stack([p[1] for p in payloads]),
+                )
+
+            for r in range(2):
+                got = assemble_rebalanced_batch(
+                    per_replica, shares, r, batch, assemble,
+                    allgather=(
+                        fake_allgather if max(shares) != min(shares)
+                        else None  # balanced: no collective may run
+                    ),
+                )
+                want = assemble(per_replica[r])
+                assert np.array_equal(got[0], want[0]), (shares, b, r)
+                assert np.array_equal(got[1], want[1]), (shares, b, r)
+
+
+def test_reassemble_math():
+    gathered = np.zeros((2, 8, 1), np.float32)
+    # host 0 read rows 0..5, host 1 rows 6..7 (shares [6, 2])
+    gathered[0, :6, 0] = np.arange(6)
+    gathered[1, :2, 0] = [6, 7]
+    out0 = reassemble_from_gathered(gathered, [6, 2], 0, 4)
+    out1 = reassemble_from_gathered(gathered, [6, 2], 1, 4)
+    assert np.array_equal(out0[:, 0], [0, 1, 2, 3])
+    assert np.array_equal(out1[:, 0], [4, 5, 6, 7])
+
+
+def test_fleet_monitor_actuates_on_loader_streak():
+    """Streak hysteresis drives the actuator: a loader-classified
+    straggler streak proposes ONE bounded shift; compute-classified
+    streaks never actuate; gauges and JSONL fields report it."""
+    from stoke_tpu.telemetry.fleet import FLEET_INDEX, FleetMonitor
+    from stoke_tpu.telemetry.registry import MetricsRegistry
+
+    cfg = FleetConfig(
+        window_steps=1, straggler_rel_frac=0.1, straggler_windows=2,
+        straggler_action="record", rebalance=True, rebalance_rows=3,
+        rebalance_max_frac=0.5,
+    )
+    reg = MetricsRegistry()
+    mon = FleetMonitor(cfg, reg, rank=0, n_processes=2)
+    rb = InputRebalancer(n_hosts=2, rank=0, batch_size=16, max_frac=0.5)
+    mon.attach_rebalancer(rb)
+    matrix = np.zeros((2, len(FLEET_INDEX)), np.float32)
+    matrix[:, FLEET_INDEX["wall_s"]] = [1.0, 1.0]
+    matrix[:, FLEET_INDEX["loader_wait_s"]] = [0.0, 0.6]
+    mon.last_matrix = matrix
+    verdict = {
+        "flagged": True, "host": 1, "skew_class": "loader",
+        "lag_s": 0.6, "lag_frac": 0.6, "zscore": None,
+    }
+    mon._update_streak(dict(verdict))  # streak 1: no actuation yet
+    assert rb.shifts == 0
+    mon._update_streak(dict(verdict))  # streak 2: fire + actuate
+    assert rb.shifts == 1 and rb.share_of(1) == 13 and rb.share_of(0) == 19
+    assert reg.counter("fleet/rebalance_shifts_total").value == 1
+    assert reg.counter("fleet/rebalance_rows_moved_total").value == 3
+    fields = mon._event_fields({
+        **verdict, "hosts": 2, "step_skew_s": 0.0, "loader_skew_s": 0.6,
+        "skew_class": "loader", "wall_median_s": 1.0, "wall_max_s": 1.0,
+        "barrier_wait_s": 0.0, "barrier_charged_host": None,
+    })
+    assert fields["fleet/rebalance_shift_rows"] == 3
+    assert fields["fleet/rebalance_from_host"] == 1
+    assert fields["fleet/rebalance_to_host"] == 0
+    assert fields["fleet/rebalance_share_self"] == 19
+    # the actuation is reported exactly once
+    fields2 = mon._event_fields({
+        **verdict, "hosts": 2, "step_skew_s": 0.0, "loader_skew_s": 0.6,
+        "skew_class": "loader", "wall_median_s": 1.0, "wall_max_s": 1.0,
+        "barrier_wait_s": 0.0, "barrier_charged_host": None,
+    })
+    assert fields2["fleet/rebalance_shift_rows"] is None
+    # compute-classified streaks never actuate
+    mon._update_streak({**verdict, "skew_class": "compute"})
+    mon._update_streak({**verdict, "skew_class": "compute"})
+    assert rb.shifts == 1
+    summary = mon.summary()
+    assert summary["rebalance"]["shifts"] == 1
+    assert summary["rebalance"]["rows_moved"] == 3
+
+
+def test_rebalance_off_adds_zero_jsonl_fields(tmp_path):
+    """Default-OFF contract: a FleetConfig run WITHOUT rebalance emits no
+    fleet/rebalance_* key (records byte-compatible with pre-ISSUE-14);
+    with rebalance ON the keys ride the schema."""
+    from stoke_tpu.telemetry import read_step_events
+
+    s = _make_stoke(tmp_path, tag="off", telemetry=True, extra=[
+        FleetConfig(window_steps=1),
+    ])
+    for x, y in _batches(3):
+        s.train_step(x, (y,))
+    s.close_telemetry()
+    records = read_step_events(
+        str(tmp_path / "off" / "telemetry" / "steps.jsonl")
+    )
+    assert records
+    assert not any(
+        k.startswith("fleet/rebalance_") for r in records for k in r
+    )
+    assert any(r.get("fleet/hosts") is not None for r in records)
+    s_on = _make_stoke(tmp_path, tag="on", telemetry=True, extra=[
+        FleetConfig(window_steps=1, rebalance=True),
+    ])
+    for x, y in _batches(3):
+        s_on.train_step(x, (y,))
+    s_on.close_telemetry()
+    records_on = read_step_events(
+        str(tmp_path / "on" / "telemetry" / "steps.jsonl")
+    )
+    window = [
+        r for r in records_on if r.get("fleet/hosts") is not None
+    ]
+    assert window and all(
+        "fleet/rebalance_share_self" in r for r in window
+    )
+
+
+def test_dataloader_requires_global_batches_sampler(tmp_path):
+    from stoke_tpu.data import StokeDataLoader
+
+    rb = InputRebalancer(n_hosts=2, rank=0, batch_size=8)
+    with pytest.raises(ValueError, match="global_batches"):
+        StokeDataLoader(
+            [(np.zeros(4, np.float32), 0.0)] * 64,
+            batch_size=8,
+            rebalancer=rb,
+        )
