@@ -7,7 +7,11 @@
 CPU_ENV = env PYTHONPATH=$(CURDIR) JAX_PLATFORMS=cpu
 MESH_ENV = $(CPU_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-full test-fast test-telemetry test-collectives test-health test-attribution test-fleet test-autotune test-resilience test-zero test-serving test-tracing test-numerics test-elastic autotune-smoke dryrun bench-smoke telemetry-smoke serve-smoke tpu-probe
+.PHONY: test test-full test-fast test-telemetry test-collectives test-health test-attribution test-fleet test-autotune test-resilience test-zero test-serving test-tracing test-numerics test-elastic test-analysis lint autotune-smoke dryrun bench-smoke telemetry-smoke serve-smoke tpu-probe
+
+lint:            ## static analysis (ISSUE 15): invariant linter (jax-free) + generated-api drift check; CI runs this before pytest
+	python scripts/stoke_lint.py
+	$(CPU_ENV) python scripts/gen_api_md.py --check
 
 test:            ## default tier (excludes @slow compile-heavy equivalence tests)
 	$(MESH_ENV) python -m pytest tests/ -x -q
@@ -56,6 +60,9 @@ test-numerics:   ## per-layer numerics tests only (module groups/provenance/quan
 
 test-elastic:    ## elastic-resilience tests only (staged saves/elastic resume/rebalancing/kill_during_save)
 	$(MESH_ENV) python -m pytest tests/ -x -q -m elastic
+
+test-analysis:   ## static-analysis tests only (invariant linter rules/waivers/manifests + live program audit)
+	$(MESH_ENV) python -m pytest tests/ -x -q -m analysis
 
 serve-smoke:     ## CPU-safe serve smoke: traced chunked-prefill + top-p request end-to-end, then the Poisson trace arm (never touches the tunnel)
 	$(MESH_ENV) python scripts/telemetry_smoke.py --serve-only

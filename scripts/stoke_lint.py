@@ -1,0 +1,210 @@
+"""stoke_lint: the repo's codified disciplines as a CLI (ISSUE 15).
+
+One command, two halves:
+
+- **Invariant linter** (default): the jax-free AST rules over the source
+  tree — append-only wire formats against the committed manifest,
+  config-knob status-rule coverage against the waiver file,
+  nullable-JSONL schema discipline, and the banned-API rules
+  (module-scope jax imports in jax-free modules — including THIS script
+  — and ``device_get`` in engine/serving hot paths).
+- **Program auditor** (``--programs``): builds a tiny live ``Stoke`` on
+  the simulated CPU mesh in a SUBPROCESS, drives all four step APIs plus
+  a serving engine, and runs ``Stoke.audit()`` over the lowered
+  programs (donation integrity, hidden host round-trips, recompile
+  hazards, sharding/collective accounting).
+
+Usage (CI runs the default mode via ``make lint``):
+
+    python scripts/stoke_lint.py                # lint the repo; exit 1 on findings
+    python scripts/stoke_lint.py --json         # machine-readable findings
+    python scripts/stoke_lint.py --programs     # + the live program audit (subprocess)
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error.
+
+Like ``scripts/autotune.py`` / ``scripts/run_resilient.py``, this
+process NEVER imports jax (a wedged TPU tunnel hangs any process at
+backend init — and CI lint must not depend on a backend at all): the
+linter module is loaded from ``stoke_tpu/analysis/invariants.py`` by
+FILE, bypassing the package ``__init__`` whose facade import would pull
+jax in, and the program audit runs in a subprocess with a pinned CPU
+environment.  The linter's own banned-API rule enforces this contract
+on this very file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+_INVARIANTS_PY = os.path.join(
+    _REPO, "stoke_tpu", "analysis", "invariants.py"
+)
+
+
+def _load_invariants(repo_root: str):
+    """Load the linter by FILE (never through the package __init__ —
+    that imports the facade and therefore jax)."""
+    path = os.path.join(repo_root, "stoke_tpu", "analysis", "invariants.py")
+    if not os.path.exists(path):
+        path = _INVARIANTS_PY
+    spec = importlib.util.spec_from_file_location(
+        "_stoke_analysis_invariants", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    # dataclass field-type resolution looks the module up in sys.modules
+    # — register before exec (the scripts/autotune.py discipline)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+#: the subprocess body for --programs: build a tiny Stoke on the 8-device
+#: CPU mesh, drive all four step APIs + a serve engine, audit, and print
+#: one JSON line of findings.  Runs under a PINNED environment so it can
+#: never touch a real accelerator tunnel.
+_PROGRAM_WORKER = r"""
+import json, sys
+import numpy as np
+import jax, jax.numpy as jnp
+import optax
+from stoke_tpu import Stoke
+
+def model(p, x):
+    return x @ p["w"]
+
+def mse(o, y):
+    return jnp.mean((o - y) ** 2)
+
+def mk(**kw):
+    return Stoke(model=model, optimizer=optax.sgd(0.1), loss=mse,
+                 params={"w": np.zeros((8, 4), np.float32)},
+                 batch_size_per_device=2, distributed="dp", verbose=False,
+                 **kw)
+
+rng = np.random.default_rng(0)
+x = rng.normal(size=(16, 8)).astype(np.float32)
+y = rng.normal(size=(16, 4)).astype(np.float32)
+
+s = mk()
+s.train_step(x, y)                                   # fused
+out = s.model(x); s.backward(s.loss(out, y)); s.step()  # 4-call accum+apply
+s2 = mk(grad_accum=2)
+xs, ys = np.stack([x, x]), np.stack([y, y])
+s2.train_step_window(xs, ys)                         # window
+s2.train_steps(np.stack([xs, xs]), np.stack([ys, ys]))  # multi
+
+# serving engine over a tiny GPT (the serve-program half)
+from stoke_tpu.configs import ServeConfig
+from stoke_tpu.models.gpt import GPT
+from stoke_tpu.serving import ServingEngine
+from stoke_tpu.utils import init_module
+gpt = GPT(vocab_size=257, size_name="tiny", max_len=128, dropout_rate=0.0)
+variables = init_module(gpt, jax.random.PRNGKey(0),
+                        np.zeros((1, 8), np.int32), train=False)
+cfg = ServeConfig(max_seqs=2, kv_block_size=8, max_seq_len=64,
+                  max_new_tokens=4, prefill_pad_multiple=16)
+eng = ServingEngine(gpt, variables["params"], cfg)
+eng.submit(np.array([5, 6, 7], np.int32))
+eng.run()
+
+findings = []
+programs = []
+for st in (s, s2):
+    before = st.dispatch_count
+    rep = st.audit(serve=eng if st is s else None)
+    assert st.dispatch_count == before, "audit dispatched a program"
+    findings += [f.to_dict() for f in rep.findings]
+    programs += rep.programs
+print(json.dumps({"programs": programs, "findings": findings}))
+"""
+
+
+def run_program_audit(repo_root: str) -> dict:
+    """Spawn the jax-side program audit with a pinned CPU environment;
+    returns the worker's JSON payload."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-c", _PROGRAM_WORKER],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=repo_root,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"program-audit worker failed (exit {proc.returncode}):\n"
+            f"{proc.stderr[-2000:]}"
+        )
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="stoke_tpu invariant linter + program auditor"
+    )
+    ap.add_argument(
+        "--repo-root",
+        default=_REPO,
+        help="tree to lint (default: this script's repo)",
+    )
+    ap.add_argument(
+        "--json",
+        action="store_true",
+        help="emit one JSON object instead of human-readable lines",
+    )
+    ap.add_argument(
+        "--programs",
+        action="store_true",
+        help="also run the live program audit (subprocess, CPU mesh)",
+    )
+    args = ap.parse_args(argv)
+    repo_root = os.path.abspath(args.repo_root)
+    if not os.path.isdir(repo_root):
+        print(f"stoke_lint: no such directory {repo_root!r}", file=sys.stderr)
+        return 2
+
+    inv = _load_invariants(repo_root)
+    findings = [f.to_dict() for f in inv.run_invariant_lints(repo_root)]
+    programs = []
+    if args.programs:
+        try:
+            payload = run_program_audit(repo_root)
+        except Exception as e:
+            print(f"stoke_lint: {e}", file=sys.stderr)
+            return 2
+        findings += payload["findings"]
+        programs = payload["programs"]
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "version": inv.LINT_VERSION,
+                    "findings": findings,
+                    "programs_audited": programs,
+                }
+            )
+        )
+    else:
+        for f in findings:
+            print(
+                f"{f['file']}:{f['line']}: [{f['rule']}] {f['message']} "
+                f"— remedy: {f['remedy']}"
+            )
+        tail = f", {len(programs)} program(s) audited" if args.programs else ""
+        print(f"stoke_lint: {len(findings)} finding(s){tail}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,47 @@
+"""Static analysis over the repo's codified disciplines (ISSUE 15).
+
+Two halves behind one CLI (``scripts/stoke_lint.py``) and one facade
+hook (``Stoke.audit()``):
+
+- :mod:`stoke_tpu.analysis.invariants` — the jax-free, AST-based
+  invariant linter (append-only wire formats, config-knob status-rule
+  coverage, nullable-JSONL discipline, banned APIs).  Loadable by FILE
+  so the CLI never imports jax.
+- :mod:`stoke_tpu.analysis.program` — the program auditor over lowered
+  jaxpr/HLO step/serve programs (donation integrity, hidden host
+  round-trips, recompile hazards, sharding/collective accounting).
+- :mod:`stoke_tpu.analysis.hlo_text` — the ONE MLIR/HLO module-name
+  normalizer shared by the AOT compile-cache key and the auditor.
+
+See docs/analysis.md for the rule catalog and waiver format.
+"""
+
+from stoke_tpu.analysis.hlo_text import normalize_module_name
+from stoke_tpu.analysis.invariants import (
+    Finding,
+    check_banned_apis,
+    check_config_coverage,
+    check_jsonl_schema,
+    check_wire_formats,
+    run_invariant_lints,
+)
+from stoke_tpu.analysis.program import (
+    AuditReport,
+    ProgramSpec,
+    abstractify_args,
+    audit_program_specs,
+)
+
+__all__ = [
+    "AuditReport",
+    "Finding",
+    "ProgramSpec",
+    "abstractify_args",
+    "audit_program_specs",
+    "check_banned_apis",
+    "check_config_coverage",
+    "check_jsonl_schema",
+    "check_wire_formats",
+    "normalize_module_name",
+    "run_invariant_lints",
+]

@@ -1,0 +1,38 @@
+"""Shared lowered-program text normalization (ISSUE 15 satellite).
+
+One normalizer, two consumers: the AOT compile-cache key
+(:func:`stoke_tpu.compile_cache.hlo_cache_key`) and the program auditor
+(:mod:`stoke_tpu.analysis.program`) both reason about lowered program
+text with the MLIR/HLO module NAME removed — the name carries the jit
+wrapper's function name plus a per-process uniquifying counter
+(``module @jit__fused.1`` when a second facade in the same process
+lowers the identical program), and a renamed module is still the same
+program.  Two hand-rolled normalizers would drift the moment one of
+them learned a new header form, silently splitting the cache key from
+the auditor's view of "the same program" — so the regexes live here and
+nowhere else.
+
+Deliberately jax-free (pure ``re``): the compile cache imports this in
+jax contexts, but nothing here needs a backend.
+"""
+
+from __future__ import annotations
+
+import re
+
+#: MLIR module header name (``module @jit__fused attributes ...``) and
+#: classic HLO header (``HloModule jit__fused, ...``) — the only places
+#: the program's WRAPPER name appears in the lowered text.
+#: ``Lowered.as_text()`` emits StableHLO MLIR on current jax, classic
+#: ``HloModule`` headers on older ones — both forms normalized.
+MLIR_MODULE_RE = re.compile(r"^(module @)[^\s{]+", flags=re.M)
+HLO_MODULE_RE = re.compile(r"^(HloModule )[^\s,]+", flags=re.M)
+
+
+def normalize_module_name(text: str) -> str:
+    """Replace the module's wrapper-derived NAME with a fixed token so
+    identical programs compare (and hash) equal regardless of which jit
+    wrapper — or which process — lowered them.  Everything else,
+    including the mhlo partition/replica attributes, is preserved."""
+    body = MLIR_MODULE_RE.sub(r"\1m", text, count=1)
+    return HLO_MODULE_RE.sub(r"\1m", body, count=1)

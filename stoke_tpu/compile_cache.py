@@ -64,7 +64,6 @@ import hashlib
 import json
 import os
 import pickle
-import re
 import threading
 import time
 import warnings
@@ -157,29 +156,22 @@ def environment_fingerprint(
     )
 
 
-#: MLIR module header name (``module @jit__fused attributes ...``) and
-#: classic HLO header (``HloModule jit__fused, ...``) — the only places
-#: the program's WRAPPER name appears in the lowered text
-_MLIR_MODULE_RE = re.compile(r"^(module @)[^\s{]+", flags=re.M)
-_HLO_MODULE_RE = re.compile(r"^(HloModule )[^\s,]+", flags=re.M)
-
-
 def hlo_cache_key(hlo_text: str, fingerprint: str) -> str:
     """Content-addressed cache key: sha256 over the lowered program body
     and the environment fingerprint.
 
-    The module NAME is normalized out before hashing — it carries the
-    jit wrapper's function name plus any per-process uniquifying counter
-    (``module @jit__fused.1`` when a second facade in the same process
-    lowers the identical program; ``Lowered.as_text()`` emits StableHLO
-    MLIR on current jax, classic ``HloModule`` headers on older ones —
-    both forms normalized), and a renamed module is still the same
-    program.  Everything else, including the mhlo partition/replica
-    attributes, stays in the hash.  Stable across processes (tested in
-    tests/test_compile_cache.py).
+    The module NAME is normalized out before hashing via the SHARED
+    :func:`stoke_tpu.analysis.hlo_text.normalize_module_name` (the
+    program auditor consumes the same normalizer — ISSUE 15: two
+    normalizers would drift): it carries the jit wrapper's function name
+    plus any per-process uniquifying counter, and a renamed module is
+    still the same program.  Everything else, including the mhlo
+    partition/replica attributes, stays in the hash.  Stable across
+    processes (tested in tests/test_compile_cache.py).
     """
-    body = _MLIR_MODULE_RE.sub(r"\1m", hlo_text, count=1)
-    body = _HLO_MODULE_RE.sub(r"\1m", body, count=1)
+    from stoke_tpu.analysis.hlo_text import normalize_module_name
+
+    body = normalize_module_name(hlo_text)
     h = hashlib.sha256()
     h.update(fingerprint.encode())
     h.update(b"\x00")

@@ -488,6 +488,26 @@ class StepEngine:
         # pre-dispatch hook — host-side only, the compiled programs are
         # untouched.  None -> dispatch untouched.
         self._chaos = None
+        # program-audit ledger (ISSUE 15): the FIRST dispatch per
+        # (program, structure key, shape signature) records an abstract
+        # spec — program name, jitted fn, ShapeDtypeStruct arg tree,
+        # declared donations — so Stoke.audit() can re-lower and
+        # statically check every program this engine actually ran,
+        # without retaining live buffers (the next step's donation
+        # deletes them) and without dispatching anything.  Purely
+        # host-side bookkeeping: compiled programs and dispatch counts
+        # are untouched (asserted in tests/test_analysis.py).
+        self._audit_specs: list = []
+        self._audit_seen: set = set()
+        # set when the spec cap dropped a NEW program signature: the
+        # audit surfaces it as a note — "zero findings" must stay
+        # distinguishable from "not audited"
+        self._audit_truncated = False
+        # per-program declared donations, recorded by _jit_program at
+        # the ONE place each build states them — the audit's donation-
+        # integrity check reads this ledger (a hand-maintained mirror
+        # of the _build_* donate_argnums would drift)
+        self._program_donations: Dict[str, Tuple[int, ...]] = {}
         # shardings, resolved lazily once variables are known
         self._var_shardings = None
         self._grad_shardings = None
@@ -791,13 +811,73 @@ class StepEngine:
         Also the fault injector's pre-dispatch hook (ISSUE 7): with a
         chaos spec armed, ``wedge_at_step`` stalls the first dispatch after
         its step here — the deterministic stand-in for a wedged collective
-        the hang watchdog exists to catch."""
+        the hang watchdog exists to catch.  And the program-audit
+        ledger's recording point (ISSUE 15): one abstract spec per
+        (program, key, sig), first dispatch only."""
         if self._chaos is not None:
             self._chaos.on_dispatch(program)
+        self._note_audit(program, key, sig, fn, args)
         cache = self._compile_cache
         if cache is None:
             return fn
         return cache.executable(program, (key, sig), fn, args)
+
+    #: bound on remembered audit specs (one per program signature; a
+    #: shape-churning run stops recording, never errors)
+    _MAX_AUDIT_SPECS = 64
+
+    def _jit_program(self, program: str, fn, *, donate: Tuple[int, ...] = (),
+                     out_shardings=None):
+        """``jax.jit`` a step program AND record its declared donations
+        under the program's audit name — stated once, here, so the
+        ISSUE 15 donation-integrity check can never drift from what the
+        jit actually received."""
+        self._program_donations[program] = tuple(donate)
+        if out_shardings is not None:
+            return jax.jit(fn, out_shardings=out_shardings,
+                           donate_argnums=donate)
+        return jax.jit(fn, donate_argnums=donate)
+
+    def _note_audit(self, program: str, key, sig, fn, args: tuple) -> None:
+        """Record one abstract ProgramSpec per (program, key, sig) for
+        the ISSUE 15 auditor — shapes/dtypes/shardings only, taken while
+        the args are still live (pre-donation)."""
+        memo = (program, key, sig)
+        if memo in self._audit_seen:
+            return
+        if len(self._audit_specs) >= self._MAX_AUDIT_SPECS:
+            self._audit_truncated = True
+            return
+        self._audit_seen.add(memo)
+        from stoke_tpu.analysis.program import ProgramSpec, abstractify_args
+
+        avals, weak = abstractify_args(args)
+        self._audit_specs.append(
+            ProgramSpec(
+                program=program,
+                fn=fn,
+                abstract_args=avals,
+                donate_argnums=self._program_donations.get(program, ()),
+                weak_leaves=weak,
+                source="engine",
+            )
+        )
+
+    def audit_specs(self) -> list:
+        """The recorded program specs (ISSUE 15; ``Stoke.audit()`` is
+        the consumer)."""
+        return list(self._audit_specs)
+
+    def shape_sig_counts(self) -> Dict[str, int]:
+        """Distinct input-shape signatures seen per program key — the
+        auditor's recompile-churn ledger.  Keyed by the program's
+        human-readable name (the first key element)."""
+        out: Dict[str, int] = {}
+        for key, seen in self._shape_sigs.items():
+            name = key[0] if isinstance(key, tuple) and key else str(key)
+            name = str(name)
+            out[name] = max(out.get(name, 0), len(seen))
+        return out
 
     # -------------------------- fused micro-step ----------------------- #
 
@@ -1045,8 +1125,8 @@ class StepEngine:
                 self._scaler_shardings(),
                 repl,  # rng
             )
-            return jax.jit(_step, out_shardings=out_sh)
-        return jax.jit(_step)
+            return self._jit_program("accum", _step, out_shardings=out_sh)
+        return self._jit_program("accum", _step)
 
     # ----------------------- scan window step --------------------------- #
 
@@ -1176,10 +1256,11 @@ class StepEngine:
                 self._numerics_shardings(),
                 repl,  # finite
             )
-            return jax.jit(
-                _window, out_shardings=out_sh, donate_argnums=(0, 1, 2, 4)
+            return self._jit_program(
+                "window", _window, out_shardings=out_sh,
+                donate=(0, 1, 2, 4),
             )
-        return jax.jit(_window, donate_argnums=(0, 1, 2, 4))
+        return self._jit_program("window", _window, donate=(0, 1, 2, 4))
 
     # ----------------------- multi-step scan ---------------------------- #
 
@@ -1303,10 +1384,10 @@ class StepEngine:
                 self._numerics_shardings(),  # stacked group-stats matrices
                 repl,  # skipped count
             )
-            return jax.jit(
-                _multi, out_shardings=out_sh, donate_argnums=(0, 1, 2, 4)
+            return self._jit_program(
+                "multi", _multi, out_shardings=out_sh, donate=(0, 1, 2, 4)
             )
-        return jax.jit(_multi, donate_argnums=(0, 1, 2, 4))
+        return self._jit_program("multi", _multi, donate=(0, 1, 2, 4))
 
     # ---------------------------- apply step --------------------------- #
 
@@ -1462,10 +1543,10 @@ class StepEngine:
                 self._numerics_shardings(),
                 self._repl,
             )
-            return jax.jit(
-                _apply, out_shardings=out_sh, donate_argnums=(0, 1, 2, 4)
+            return self._jit_program(
+                "apply", _apply, out_shardings=out_sh, donate=(0, 1, 2, 4)
             )
-        return jax.jit(_apply, donate_argnums=(0, 1, 2, 4))
+        return self._jit_program("apply", _apply, donate=(0, 1, 2, 4))
 
     # ------------------------ fused train step -------------------------- #
 
@@ -1599,10 +1680,11 @@ class StepEngine:
                     self._numerics_shardings(),
                     repl,  # finite
                 )
-                return jax.jit(
-                    _fused, out_shardings=out_sh, donate_argnums=(0, 1, 2, 4)
+                return self._jit_program(
+                    "fused", _fused, out_shardings=out_sh,
+                    donate=(0, 1, 2, 4),
                 )
-            return jax.jit(_fused, donate_argnums=(0, 1, 2, 4))
+            return self._jit_program("fused", _fused, donate=(0, 1, 2, 4))
 
         def _fused_nb(variables, grad_buf, scaler_state, rng, margs, mkwargs,
                       larr):
@@ -1629,8 +1711,10 @@ class StepEngine:
                 repl,  # rng
                 repl,  # finite
             )
-            return jax.jit(_fused_nb, out_shardings=out_sh, donate_argnums=(0, 1))
-        return jax.jit(_fused_nb, donate_argnums=(0, 1))
+            return self._jit_program(
+                "fused_nb", _fused_nb, out_shardings=out_sh, donate=(0, 1)
+            )
+        return self._jit_program("fused_nb", _fused_nb, donate=(0, 1))
 
     # --------------------------- loss-only ----------------------------- #
 

@@ -1506,6 +1506,89 @@ class Stoke:
             return None
         return self._tracer.export(path)
 
+    def audit(
+        self,
+        serve=None,
+        *,
+        replicated_bytes_threshold: Optional[int] = None,
+        churn_threshold: Optional[int] = None,
+    ):
+        """Static program audit of this LIVE build (ISSUE 15): re-lower
+        every step program the engine has dispatched (and, with
+        ``serve=engine``, a serving engine's prefill/decode/chunk
+        programs) from their recorded abstract specs and check the
+        repo's codified program invariants — donation integrity (every
+        declared ``donate_argnums`` entry actually aliased; no
+        deserialized-executable dispatch, the PR-6/PR-14 hazard), hidden
+        host round-trips (callbacks/infeed in a step program), recompile
+        hazards (weak-typed scalar args, shape-signature churn against
+        the engine's 1024-entry memo), and the sharding audit (large
+        replicated tensors on a partitioned program; collectives
+        cross-checked against the gradient transport's analytic
+        ``bytes_per_step``).
+
+        Lowering/tracing only — NO compile, NO dispatch: the compiled
+        programs, dispatch count, and training state are untouched
+        (dispatch-count equality is acceptance-tested).  Returns an
+        :class:`~stoke_tpu.analysis.program.AuditReport`; findings carry
+        rule ids and named remedies (the status-rule discipline), tick
+        ``analysis/programs_audited_total`` /
+        ``analysis/audit_findings_total`` on the telemetry registry, and
+        are warned once rank-0 so an interactive audit is never silent.
+
+        Run the step APIs you care about first — the audit covers what
+        the engine actually dispatched (``scripts/stoke_lint.py
+        --programs`` drives all four step APIs end-to-end; the jax-free
+        source lints live there too)."""
+        from stoke_tpu.analysis.program import audit_program_specs
+
+        specs = self._engine.audit_specs()
+        if serve is not None:
+            specs += serve.audit_specs()
+        kwargs = {}
+        if replicated_bytes_threshold is not None:
+            kwargs["replicated_bytes_threshold"] = replicated_bytes_threshold
+        if churn_threshold is not None:
+            kwargs["churn_threshold"] = churn_threshold
+        report = audit_program_specs(
+            specs,
+            transport_active=self._engine.transport.active,
+            comm_bytes=self._comm_bytes,
+            # None (not {}) when the engine never tracked signatures —
+            # the churn rule then reports itself unchecked instead of
+            # vacuously clean
+            shape_sig_counts=(
+                self._engine.shape_sig_counts()
+                if self._engine._compile_tracker is not None
+                else None
+            ),
+            **kwargs,
+        )
+        if self._engine._audit_truncated:
+            report.notes.append(
+                f"program inventory truncated at the engine's "
+                f"{self._engine._MAX_AUDIT_SPECS}-spec audit cap — "
+                f"programs first dispatched after the cap were NOT "
+                f"audited"
+            )
+        reg = self._telemetry.registry
+        reg.counter(
+            "analysis/programs_audited_total",
+            help="programs checked by Stoke.audit()",
+        ).inc(len(report.programs))
+        reg.counter(
+            "analysis/audit_findings_total",
+            help="program-audit findings (docs/analysis.md rule catalog)",
+        ).inc(len(report.findings))
+        if report.findings and self.is_rank_0:
+            import warnings
+
+            warnings.warn(
+                "Stoke -- program audit found "
+                f"{len(report.findings)} issue(s):\n" + report.format()
+            )
+        return report
+
     @property
     def dispatch_count(self) -> int:
         """Compiled-program invocations issued by this run's engine (the

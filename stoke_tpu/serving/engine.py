@@ -320,6 +320,15 @@ class ServingEngine:
             else None
         )
 
+        # program-audit ledger (ISSUE 15): one abstract spec per
+        # (program, shape signature), recorded at the dispatch funnel so
+        # Stoke.audit() can statically check the serve programs exactly
+        # like the step programs — donation per the tuple jit actually
+        # received (empty on CPU, where pages are copied, not donated)
+        self._donate = donate
+        self._audit_specs: list = []
+        self._audit_seen: set = set()
+
         self._iterations = 0
         self._last_emit_iter = 0
         self._t_start = time.perf_counter()
@@ -461,12 +470,43 @@ class ServingEngine:
             if hasattr(l, "shape")
         )
 
+    def _note_audit(self, program: str, fn, args: tuple) -> None:
+        """Record one abstract ProgramSpec per PROGRAM for the ISSUE 15
+        auditor (the StepEngine._note_audit contract: shapes/dtypes/
+        shardings only, pre-donation).  Keyed by program NAME alone —
+        pad buckets share one program body, and auditing one
+        representative keeps the steady-state decode loop's cost at a
+        single set lookup (no per-token tree walk)."""
+        if program in self._audit_seen:
+            return
+        self._audit_seen.add(program)
+        from stoke_tpu.analysis.program import ProgramSpec, abstractify_args
+
+        avals, weak = abstractify_args(args)
+        self._audit_specs.append(
+            ProgramSpec(
+                program=program,
+                fn=fn,
+                abstract_args=avals,
+                donate_argnums=self._donate,
+                weak_leaves=weak,
+                source="serve",
+            )
+        )
+
+    def audit_specs(self) -> list:
+        """The recorded serve-program specs (ISSUE 15; consumed by
+        ``Stoke.audit(serve=engine)`` or a standalone
+        ``audit_program_specs`` call)."""
+        return list(self._audit_specs)
+
     def _dispatch(self, program: str, fn, args: tuple):
         """Route one dispatch through the compile cache's program ledger
         (same contract as ``StepEngine._aot_call``): first dispatch per
         (program, shape signature) checks the HLO-keyed ledger — warm
         starts resolve to an already-built fn and book reclaimed compile
         seconds — and every dispatch runs plain ``jax.jit`` semantics."""
+        self._note_audit(program, fn, args)
         cc = self._compile_cache
         if cc is not None:
             fn = cc.executable(program, (program, self._sig(args)), fn, args)

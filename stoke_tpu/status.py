@@ -537,6 +537,38 @@ class StokeStatus:
                     f"HealthConfig.watchdog requires watchdog_timeout_s > 0,"
                     f" got {cfg.watchdog_timeout_s}"
                 )
+            # detector-threshold sanity (ISSUE 15 knob-coverage lint): a
+            # zero/negative threshold is a detector that fires every step
+            # or never — a typo, not a tuning choice
+            if not (0.0 < cfg.ema_alpha <= 1.0):
+                return (
+                    f"HealthConfig.ema_alpha must be in (0, 1], got "
+                    f"{cfg.ema_alpha}"
+                )
+            for field in ("loss_spike_zscore", "grad_spike_zscore",
+                          "comm_residual_factor"):
+                if getattr(cfg, field) <= 0:
+                    return (
+                        f"HealthConfig.{field} must be > 0, got "
+                        f"{getattr(cfg, field)}"
+                    )
+            for field in ("scaler_skip_streak", "recompile_storm_threshold",
+                          "recompile_storm_window", "starvation_streak"):
+                if getattr(cfg, field) < 1:
+                    return (
+                        f"HealthConfig.{field} must be >= 1, got "
+                        f"{getattr(cfg, field)}"
+                    )
+            if cfg.max_dumps < 0:
+                return (
+                    f"HealthConfig.max_dumps must be >= 0 (0 disables "
+                    f"capped dumps), got {cfg.max_dumps}"
+                )
+            if cfg.watchdog_compile_grace_s < 0:
+                return (
+                    f"HealthConfig.watchdog_compile_grace_s must be >= 0,"
+                    f" got {cfg.watchdog_compile_grace_s}"
+                )
             return False
 
         def _attribution_invalid(s):
@@ -565,6 +597,16 @@ class StokeStatus:
                 return (
                     "AttributionConfig.peak_hbm_gbps/ici_gbps must be >= 0 "
                     "(0 disables that roofline leg)"
+                )
+            if not (0.0 < cfg.ema_alpha <= 1.0):
+                return (
+                    f"AttributionConfig.ema_alpha must be in (0, 1], got "
+                    f"{cfg.ema_alpha}"
+                )
+            if cfg.capture_warmup_windows < 0:
+                return (
+                    f"AttributionConfig.capture_warmup_windows must be "
+                    f">= 0, got {cfg.capture_warmup_windows}"
                 )
             if cfg.auto_capture:
                 pc = self._configs.get("ProfilerConfig")
@@ -727,13 +769,37 @@ class StokeStatus:
             return False
 
         def _checkpoint_invalid(s):
-            """Checkpoint-layout legality (ISSUE 14): offload staging is
-            the zero-stall path for ASYNC CONSOLIDATED saves — on the
-            sync path there is no background writer to hand the staged
-            references to, and the sharded (orbax) path already stages
-            its own device→host copy."""
+            """Checkpoint-layout legality (ISSUE 14, extended by ISSUE
+            15's knob-coverage lint): the periodic-save cadence must be
+            able to fire — ``save_every_n_steps`` without an
+            ``auto_path`` makes ``_maybe_auto_save`` a silent no-op
+            (the silently-ignored-knob anti-pattern) — and offload
+            staging is the zero-stall path for ASYNC CONSOLIDATED saves
+            only — on the sync path there is no background writer to
+            hand the staged references to, and the sharded (orbax) path
+            already stages its own device→host copy."""
             cfg = self._configs.get("CheckpointConfig")
-            if cfg is None or not getattr(cfg, "offload_staging", False):
+            if cfg is None:
+                return False
+            if cfg.save_every_n_steps is not None:
+                if cfg.save_every_n_steps < 1:
+                    return (
+                        f"CheckpointConfig.save_every_n_steps must be "
+                        f">= 1 or None, got {cfg.save_every_n_steps}"
+                    )
+                if not cfg.auto_path:
+                    return (
+                        "CheckpointConfig.save_every_n_steps is set but "
+                        "auto_path is not — the periodic auto-save would "
+                        "silently never write; set auto_path or drop the "
+                        "cadence"
+                    )
+            if cfg.save_rank < 0:
+                return (
+                    f"CheckpointConfig.save_rank must be >= 0 (taken "
+                    f"modulo the process count), got {cfg.save_rank}"
+                )
+            if not getattr(cfg, "offload_staging", False):
                 return False
             if not cfg.async_save:
                 return (
@@ -801,6 +867,20 @@ class StokeStatus:
                 return (
                     f"ResilienceConfig.max_to_keep must be >= 1 or None, "
                     f"got {cfg.max_to_keep}"
+                )
+            ckpt = self._configs.get("CheckpointConfig")
+            if (
+                ckpt is not None
+                and ckpt.auto_path
+                and cfg.save_name == ckpt.auto_name
+                and os.path.abspath(cfg.save_path)
+                == os.path.abspath(ckpt.auto_path)
+            ):
+                return (
+                    f"ResilienceConfig.save_name {cfg.save_name!r} "
+                    f"collides with CheckpointConfig.auto_name under the "
+                    f"same directory — the two save cadences would prune "
+                    f"each other's tags; rename one or separate the paths"
                 )
             spec = (
                 cfg.chaos if cfg.chaos is not None
@@ -997,6 +1077,16 @@ class StokeStatus:
                     f"ServeConfig.quant_chunk_elems must be >= 1, got "
                     f"{cfg.quant_chunk_elems}"
                 )
+            if cfg.quant_min_size < 0:
+                return (
+                    f"ServeConfig.quant_min_size must be >= 0 (leaves "
+                    f"below it stay unquantized), got {cfg.quant_min_size}"
+                )
+            if cfg.eos_id is not None and cfg.eos_id < 0:
+                return (
+                    f"ServeConfig.eos_id must be a token id >= 0 when "
+                    f"set (None = run to the token cap), got {cfg.eos_id}"
+                )
             if cfg.prefill_pad_multiple > cfg.max_seq_len:
                 return (
                     f"ServeConfig.prefill_pad_multiple "
@@ -1016,6 +1106,77 @@ class StokeStatus:
                         f"tokens incl. the reserved scratch block 0) — no "
                         f"request could ever be admitted"
                     )
+            return False
+
+        def _remat_invalid(s):
+            """Rematerialization legality (ISSUE 15 knob-coverage lint):
+            a typo'd checkpoint policy previously surfaced as a bare
+            AttributeError at the FIRST step compile, deep inside the
+            engine — validate it here with the remedy named instead."""
+            cfg = self._configs.get("ActivationCheckpointingConfig")
+            if cfg is None:
+                return False
+            import jax
+
+            if not isinstance(cfg.policy, str) or not hasattr(
+                jax.checkpoint_policies, cfg.policy
+            ):
+                return (
+                    f"ActivationCheckpointingConfig.policy {cfg.policy!r} "
+                    f"is not a jax.checkpoint_policies member — use e.g. "
+                    f"'nothing_saveable', 'dots_saveable', "
+                    f"'dots_with_no_batch_dims_saveable', or "
+                    f"'everything_saveable'"
+                )
+            return False
+
+        def _precision_scaler_invalid(s):
+            """Loss-scaler knob sanity (ISSUE 15 knob-coverage lint): a
+            non-positive scale or a backoff that GROWS the scale is a
+            scaler that can never recover from overflow — a typo, not a
+            tuning choice.  Checked whenever a PrecisionConfig is
+            supplied (the values must be sane even while fp16 is off)."""
+            cfg = self._configs.get("PrecisionConfig")
+            if cfg is None:
+                return False
+            if cfg.init_scale <= 0 or cfg.min_scale <= 0:
+                return (
+                    f"PrecisionConfig.init_scale/min_scale must be > 0, "
+                    f"got {cfg.init_scale}/{cfg.min_scale}"
+                )
+            if cfg.growth_factor < 1.0:
+                return (
+                    f"PrecisionConfig.growth_factor must be >= 1 (growth "
+                    f"never shrinks the scale), got {cfg.growth_factor}"
+                )
+            if not (0.0 < cfg.backoff_factor <= 1.0):
+                return (
+                    f"PrecisionConfig.backoff_factor must be in (0, 1] "
+                    f"(backoff never grows the scale), got "
+                    f"{cfg.backoff_factor}"
+                )
+            if cfg.growth_interval < 1:
+                return (
+                    f"PrecisionConfig.growth_interval must be >= 1, got "
+                    f"{cfg.growth_interval}"
+                )
+            return False
+
+        def _fsdp_pref_invalid(s):
+            """A typo'd ``shard_axis_preference`` previously fell through
+            to the 'largest' branch silently (ISSUE 15 knob-coverage
+            lint caught it; parallel/sharding.py dispatches on the
+            string)."""
+            cfg = self._configs.get("FSDPConfig")
+            if cfg is None:
+                return False
+            if cfg.shard_axis_preference not in ("largest", "first"):
+                return (
+                    f"FSDPConfig.shard_axis_preference "
+                    f"{cfg.shard_axis_preference!r} unknown; valid: "
+                    f"['largest', 'first'] — any other value would "
+                    f"silently act as 'largest'"
+                )
             return False
 
         def _offload_cpu_no_fallback(s):
@@ -1056,6 +1217,24 @@ class StokeStatus:
                 lambda s: s["grad_clip"] is not None
                 and not isinstance(s["grad_clip"], (ClipGradConfig, ClipGradNormConfig)),
                 "grad_clip must be ClipGradConfig, ClipGradNormConfig, or None",
+            ),
+            # clip-bound sanity (ISSUE 15 knob-coverage lint): a zero or
+            # negative bound zeroes/flips every gradient — a typo, never
+            # a tuning choice; norm_type < 1 is not a norm
+            (
+                lambda s: isinstance(s["grad_clip"], ClipGradConfig)
+                and s["grad_clip"].clip_value <= 0,
+                "ClipGradConfig.clip_value must be > 0 (an elementwise "
+                "bound of 0 zeroes every gradient)",
+            ),
+            (
+                lambda s: isinstance(s["grad_clip"], ClipGradNormConfig)
+                and (
+                    s["grad_clip"].max_norm <= 0
+                    or s["grad_clip"].norm_type < 1
+                ),
+                "ClipGradNormConfig needs max_norm > 0 and norm_type >= 1 "
+                "(inf is legal)",
             ),
             # per-loss scalers are an fp16 feature (reference: Apex
             # num_losses configures amp loss scalers, fp16.py:656-691;
@@ -1178,6 +1357,18 @@ class StokeStatus:
             (
                 _trace_invalid,
                 "TraceConfig is invalid",
+            ),
+            (
+                _remat_invalid,
+                "ActivationCheckpointingConfig.policy is invalid",
+            ),
+            (
+                _precision_scaler_invalid,
+                "PrecisionConfig scaler knobs are invalid",
+            ),
+            (
+                _fsdp_pref_invalid,
+                "FSDPConfig.shard_axis_preference is invalid",
             ),
             (
                 _offload_cpu_no_fallback,
