@@ -9,8 +9,9 @@ MESH_ENV = $(CPU_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test test-full test-fast test-telemetry test-collectives test-health test-attribution test-fleet test-autotune test-resilience test-zero test-serving test-tracing test-numerics test-elastic test-analysis lint autotune-smoke dryrun bench-smoke telemetry-smoke serve-smoke tpu-probe
 
-lint:            ## static analysis (ISSUE 15): invariant linter (jax-free) + generated-api drift check; CI runs this before pytest
+lint:            ## static analysis (ISSUE 15): invariant linter (jax-free), program auditor over the lowered step/serve programs, + generated-api drift check; CI runs this before pytest
 	python scripts/stoke_lint.py
+	$(CPU_ENV) python scripts/stoke_lint.py --programs
 	$(CPU_ENV) python scripts/gen_api_md.py --check
 
 test:            ## default tier (excludes @slow compile-heavy equivalence tests)

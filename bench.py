@@ -115,6 +115,8 @@ _SERVE_KEY_DEFAULTS = {
     "serve_decode_kernel": "reference",
     "serve_sampling": "greedy",
     "serve_long_prompt": False,
+    # pre-ISSUE-16 serve records carried no SLO-tagged requests
+    "serve_priority_mix": False,
 }
 
 
@@ -190,7 +192,13 @@ def _emit_persisted(metric: str, capture_error: str,
                         "serve", "serve_quant", "serve_max_seqs",
                         "serve_decode_kernel", "serve_prefill_chunk",
                         "serve_sampling", "serve_long_prompt",
+                        "serve_priority_mix",
                         "tpot_stall_chunked_s", "tpot_stall_unchunked_s",
+                        "slo_attainment_interactive",
+                        "slo_attainment_batch",
+                        "slo_goodput_tokens_per_s",
+                        "slo_goodput_tokens_per_s_interactive",
+                        "slo_goodput_tokens_per_s_batch",
                         "ttft_p50_s", "ttft_p99_s", "tpot_p50_s",
                         "tpot_p99_s", "batch_fill_mean",
                         "kv_occupancy_peak", "quant_compression",
@@ -234,7 +242,7 @@ _REGRESSION_CONFIG_KEYS = (
     "health", "attribution", "fleet", "tuned", "resilience", "trace",
     "numerics", "serve", "serve_quant", "serve_max_seqs",
     "serve_decode_kernel", "serve_prefill_chunk", "serve_sampling",
-    "serve_long_prompt",
+    "serve_long_prompt", "serve_priority_mix",
 )
 
 
@@ -512,7 +520,7 @@ def _serve_bench(args, tiny: bool) -> int:
 
     from stoke_tpu.configs import ServeConfig
     from stoke_tpu.models.gpt import GPT
-    from stoke_tpu.serving import ServingEngine
+    from stoke_tpu.serving import RequestSLO, ServingEngine
     from stoke_tpu.utils import init_module
 
     on_accel = jax.default_backend() not in ("cpu",)
@@ -530,6 +538,18 @@ def _serve_bench(args, tiny: bool) -> int:
     long_arm = bool(args.serve_long_prompt)
     chunk = args.serve_prefill_chunk or (32 if long_arm else None)
     sampling = args.serve_sampling != "greedy"
+    # priority-mix arm (ISSUE 16): alternate every submitted request
+    # between two SLO classes — "interactive" with tight deadlines (the
+    # class the attainment fraction is expected to strain under load) and
+    # "batch" with loose ones — and report per-class attainment plus
+    # goodput-under-SLO tokens/s beside the raw-throughput headline
+    mix = bool(args.serve_priority_mix)
+    _MIX_SLOS = (
+        RequestSLO(priority="interactive",
+                   ttft_target_s=0.5, tpot_target_s=0.1),
+        RequestSLO(priority="batch",
+                   ttft_target_s=10.0, tpot_target_s=1.0),
+    )
 
     def build_engine(chunk_tokens):
         cfg = ServeConfig(
@@ -584,11 +604,14 @@ def _serve_bench(args, tiny: bool) -> int:
                 return len(s.request.tokens)
         return 0
 
-    def trace_pass(engine):
+    def trace_pass(engine, tag_slo=False):
         """One pass over the trace.  In the long-prompt arm the long
         request admits after the shorts start decoding, and the return
         carries the worst inter-token gap any short request saw — the
-        TPOT stall the chunked/unchunked comparison reports."""
+        TPOT stall the chunked/unchunked comparison reports.  With
+        ``tag_slo`` (the priority-mix arm's MEASURED pass only — the warm
+        pass's compile-dominated latencies must not poison attainment)
+        every request alternates between the two SLO classes."""
         fills, occs = [], []
         i = 0
         base = time.perf_counter()
@@ -599,7 +622,10 @@ def _serve_bench(args, tiny: bool) -> int:
         while i < len(prompts) or engine.scheduler.has_work:
             now = time.perf_counter() - base
             while i < len(prompts) and arrivals[i] <= now:
-                rid = engine.submit(prompts[i], int(out_lens[i]))
+                rid = engine.submit(
+                    prompts[i], int(out_lens[i]),
+                    slo=_MIX_SLOS[i % 2] if (tag_slo and mix) else None,
+                )
                 watch[rid] = (0, time.perf_counter())
                 i += 1
             if long_arm and not long_submitted and i >= len(prompts):
@@ -633,7 +659,27 @@ def _serve_bench(args, tiny: bool) -> int:
     # steady-state latency is the claim: drop the warm pass's compile-
     # dominated TTFT/TPOT samples before the measured pass
     eng.metrics.reset_latency_reservoirs()
-    measured = trace_pass(eng)
+    measured = trace_pass(eng, tag_slo=True)
+
+    slo_cols = {}
+    if mix:
+        # per-class attainment + goodput-under-SLO (ISSUE 16): tokens of
+        # requests that MET their deadlines per wall second — the
+        # measuring stick beside the raw tokens/s headline
+        by_class = eng.slo.summary().get("by_class", {})
+        wall = max(measured["wall_s"], 1e-9)
+        slo_cols["slo_goodput_tokens_per_s"] = round(
+            eng.slo.goodput_tokens_per_s(), 2
+        )
+        for cls in ("interactive", "batch"):
+            st = by_class.get(cls, {})
+            att = st.get("attainment")
+            slo_cols[f"slo_attainment_{cls}"] = (
+                None if att is None else round(att, 4)
+            )
+            slo_cols[f"slo_goodput_tokens_per_s_{cls}"] = round(
+                st.get("goodput_tokens", 0) / wall, 2
+            )
 
     stall_unchunked = None
     if long_arm:
@@ -661,6 +707,7 @@ def _serve_bench(args, tiny: bool) -> int:
         "serve_prefill_chunk": chunk,
         "serve_sampling": args.serve_sampling,
         "serve_long_prompt": True if long_arm else None,
+        "serve_priority_mix": True if mix else None,
         **(
             {
                 "tpot_stall_chunked_s": round(measured["tpot_stall_s"], 6),
@@ -669,6 +716,7 @@ def _serve_bench(args, tiny: bool) -> int:
             if long_arm
             else {}
         ),
+        **slo_cols,
         "requests": n,
         "ttft_p50_s": round(pct["ttft_p50_s"], 6),
         "ttft_p99_s": round(pct["ttft_p99_s"], 6),
@@ -700,6 +748,7 @@ def _serve_bench(args, tiny: bool) -> int:
                 "serve_prefill_chunk": chunk,
                 "serve_sampling": args.serve_sampling,
                 "serve_long_prompt": True if long_arm else None,
+                "serve_priority_mix": True if mix else None,
             },
         )
         if regression is not None:
@@ -728,6 +777,7 @@ def _serve_bench(args, tiny: bool) -> int:
                 "serve_prefill_chunk": chunk,
                 "serve_sampling": args.serve_sampling,
                 "serve_long_prompt": True if long_arm else None,
+                "serve_priority_mix": True if mix else None,
                 **(
                     {
                         "tpot_stall_chunked_s": result[
@@ -740,6 +790,7 @@ def _serve_bench(args, tiny: bool) -> int:
                     if long_arm
                     else {}
                 ),
+                **slo_cols,
                 "requests": n,
                 "ttft_p50_s": result["ttft_p50_s"],
                 "ttft_p99_s": result["ttft_p99_s"],
@@ -929,6 +980,16 @@ def main():
                     "pad bucket) and WITHOUT (tpot_stall_unchunked_s) — "
                     "the column pair that shows what chunking buys.  A "
                     "distinct configuration for the guards")
+    ap.add_argument("--serve-priority-mix", action="store_true",
+                    help="priority-mix arm (ISSUE 16): every request in "
+                    "the Poisson trace carries a RequestSLO, alternating "
+                    "between an 'interactive' class (tight TTFT/TPOT "
+                    "deadlines) and a 'batch' class (loose ones); reports "
+                    "per-class SLO attainment fractions and "
+                    "goodput-under-SLO tokens/s (tokens of requests that "
+                    "met their deadlines) beside the raw throughput "
+                    "headline.  A distinct configuration for the "
+                    "stale-substitution and regression guards")
     ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
     tuned_rec = None
@@ -1016,6 +1077,9 @@ def main():
                 ),
                 "serve_long_prompt": (
                     bool(args.serve_long_prompt) if args.serve else None
+                ),
+                "serve_priority_mix": (
+                    bool(args.serve_priority_mix) if args.serve else None
                 ),
                 "tuned": True if args.tuned else None,
                 "fleet": True if args.fleet else None,

@@ -99,6 +99,28 @@ def load_events(path: str) -> List[Dict[str, Any]]:
     return events
 
 
+def load_dropped(path: str) -> Optional[int]:
+    """Spans the rank's bounded ring evicted before export, read from the
+    exporter's ``stoke`` metadata block (ISSUE 16).  ``None`` for files
+    that carry no metadata (bare-list chrome traces) — unknown is
+    reported as unknown, never as zero: a truncated ring must not
+    masquerade as a complete timeline."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict):
+        return None
+    meta = doc.get("stoke")
+    if not isinstance(meta, dict) or "dropped" not in meta:
+        return None
+    try:
+        return int(meta["dropped"])
+    except (TypeError, ValueError):
+        return None
+
+
 def _steps_present(events: List[Dict[str, Any]]) -> set:
     return {
         e["args"]["step"]
@@ -204,6 +226,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("no trace*.json files found", file=sys.stderr)
         return 2
     traces: Dict[int, List[Dict[str, Any]]] = {}
+    dropped: Dict[int, Optional[int]] = {}
     for rank, path in found:
         try:
             events = load_events(path)
@@ -214,6 +237,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             continue
         if events:
             traces[rank] = events
+            dropped[rank] = load_dropped(path)
     if not traces:
         print("no readable events in any trace", file=sys.stderr)
         return 2
@@ -226,6 +250,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, f)
         f.write("\n")
     report["out"] = args.out
+    # ring truncation surfaced beside the merge (ISSUE 16): each rank's
+    # evicted-span count and the pod total — a nonzero total means the
+    # merged timeline is the recent WINDOW, not the complete run, and
+    # any critical-path read off it is partial
+    report["dropped_by_rank"] = {str(r): dropped.get(r) for r in
+                                 report["ranks"]}
+    known = [d for d in dropped.values() if d is not None]
+    report["trace/dropped_total"] = sum(known) if known else None
     if args.json:
         print(json.dumps(report, indent=2, sort_keys=True))
     else:
@@ -235,7 +267,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"-> {args.out}"
         )
         for r in report["ranks"]:
-            print(f"  rank {r}: shift {report['shift_us'][str(r)]:+.1f} us")
+            d = dropped.get(r)
+            d_note = "dropped unknown" if d is None else f"dropped {d}"
+            print(
+                f"  rank {r}: shift {report['shift_us'][str(r)]:+.1f} us, "
+                f"{d_note}"
+            )
+        total = report["trace/dropped_total"]
+        if total:
+            print(
+                f"  WARNING: trace/dropped_total={total} — rings evicted "
+                f"spans; the merged timeline is PARTIAL (recent window "
+                f"only)"
+            )
     return 0
 
 

@@ -22,8 +22,11 @@ offline numerics_diff.py alignment of two smoke JSONLs); since ISSUE 13,
 the serve cycle additionally runs one chunked-prefill + top-p request
 (chunk/sampled counters in the JSONL, ``serve/prefill_chunk`` spans
 asserted in the traced timeline; ``--serve-only`` runs just that leg —
-the ``make serve-smoke`` entry).  Prints the step record and a one-line
-verdict; exit 0 only when everything round-trips.
+the ``make serve-smoke`` entry); since ISSUE 16, one SLO-tagged request
+(serve/slo_* JSONL fields, attainment in the summary block, and the
+span-walked violation attribution whose buckets sum to the measured
+end-to-end latency).  Prints the step record and a one-line verdict;
+exit 0 only when everything round-trips.
 """
 
 from __future__ import annotations
@@ -43,15 +46,17 @@ def _trace_events(path):
 
 
 def run_serve_cycle(sv_dir: str) -> dict:
-    """One traced serve cycle end-to-end (ISSUE 9, grown by ISSUE 13):
+    """One traced serve cycle end-to-end (ISSUE 9, grown by 13 and 16):
     two concurrent greedy requests PLUS one long chunked-prefill + top-p
-    request through the continuous-batching engine (int8 weights), with
-    the serve/* JSONL fields populated (compression >= 3.5x,
-    prefill-chunk and sampled-token counters), every KV block back in the
-    pool after the drain, and the per-request span timelines — including
-    the ``serve/prefill_chunk`` chunk spans — asserted in the exported
-    trace.  Callable standalone (``--serve-only``, the ``make
-    serve-smoke`` leg) or as part of the full smoke."""
+    request PLUS one SLO-tagged request through the continuous-batching
+    engine (int8 weights), with the serve/* JSONL fields populated
+    (compression >= 3.5x, prefill-chunk and sampled-token counters, the
+    nullable serve/slo_* attainment fields), every KV block back in the
+    pool after the drain, the per-request span timelines — including the
+    ``serve/prefill_chunk`` chunk spans — asserted in the exported
+    trace, and the SLO request's span-walked attribution summing to its
+    end-to-end latency.  Callable standalone (``--serve-only``, the
+    ``make serve-smoke`` leg) or as part of the full smoke."""
     import numpy as np
     import optax
 
@@ -65,7 +70,7 @@ def run_serve_cycle(sv_dir: str) -> dict:
         TraceConfig,
     )
     from stoke_tpu.models.gpt import GPT
-    from stoke_tpu.serving import SamplingParams
+    from stoke_tpu.serving import RequestSLO, SamplingParams
     from stoke_tpu.telemetry import read_step_events
     from stoke_tpu.utils import init_module
 
@@ -118,6 +123,15 @@ def run_serve_cycle(sv_dir: str) -> dict:
         sv_r.integers(1, 211, size=40).astype(np.int32), 4,
         sampling=SamplingParams(temperature=0.7, top_p=0.9, seed=1),
     )
+    # ISSUE 16: one SLO-tagged request — deadlines generous enough that a
+    # CPU smoke attains them deterministically; the serve/slo_* JSONL
+    # fields, the summary block, and the span-walked violation
+    # attribution are asserted below
+    slo_rid = sv_eng.submit(
+        sv_r.integers(1, 211, size=9).astype(np.int32), 4,
+        slo=RequestSLO(priority="interactive",
+                       ttft_target_s=60.0, tpot_target_s=60.0),
+    )
     sv_eng.run()
     sv.close_telemetry()
     sv_rec = read_step_events(os.path.join(sv_dir, "steps.jsonl"))[-1]
@@ -133,12 +147,22 @@ def run_serve_cycle(sv_dir: str) -> dict:
     chunk_spans = [
         e for e in serve_trace if e["name"] == "serve/prefill_chunk"
     ]
+    # ISSUE 16: the SLO-tagged request's attainment and span-walked
+    # attribution — buckets must sum to the measured end-to-end latency,
+    # with full span coverage (the cycle runs traced)
+    slo_attr = sv_eng.slo.attributions.get(slo_rid, {})
+    slo_summary = sv_eng.summary().get("slo", {})
+    slo_bucket_sum = (
+        slo_attr.get("queue_wait_s", 0.0)
+        + slo_attr.get("prefill_blocked_s", 0.0)
+        + slo_attr.get("decode_contention_s", 0.0)
+    )
     ok = (
         all(
             len(sv_eng.scheduler.finished[rid].tokens) == 4
-            for rid in sv_rids + [long_rid]
+            for rid in sv_rids + [long_rid, slo_rid]
         )
-        and sv_rec.get("serve/completed") == 3.0
+        and sv_rec.get("serve/completed") == 4.0
         and sv_rec.get("serve/ttft_p50_s") is not None
         and sv_rec.get("serve/tpot_p50_s") is not None
         and (sv_rec.get("serve/quant_compression") or 0) >= 3.5
@@ -154,6 +178,18 @@ def run_serve_cycle(sv_dir: str) -> dict:
         and len(chunk_spans) == 3
         and {"serve/prefill_chunk", "serve/decode"}
         <= spans_by_rid.get(long_rid, set())
+        # ISSUE 16: SLO wire evidence — the nullable serve/slo_* fields
+        # in the JSONL record, attainment in the summary block, and the
+        # attribution identity queue+prefill+decode == e2e
+        and sv_rec.get("serve/slo_requests") == 1.0
+        and sv_rec.get("serve/slo_attainment") == 1.0
+        and sv_rec.get("serve/slo_goodput_tokens_per_s") is not None
+        and slo_attr.get("attained") is True
+        and slo_attr.get("span_coverage") == "full"
+        and slo_attr.get("partial") is False
+        and abs(slo_bucket_sum - slo_attr.get("e2e_s", -1.0)) < 1e-9
+        and slo_summary.get("by_class", {})
+        .get("interactive", {}).get("attained") == 1
     )
     return {
         "ok": ok,
@@ -165,6 +201,9 @@ def run_serve_cycle(sv_dir: str) -> dict:
         "chunk_spans": len(chunk_spans),
         "long_rid": long_rid,
         "long_tokens": list(sv_eng.scheduler.finished[long_rid].tokens),
+        "slo_rid": slo_rid,
+        "slo_attribution": slo_attr,
+        "slo_summary": slo_summary,
     }
 
 
@@ -652,6 +691,10 @@ def main() -> int:
         "serve_quant_compression": sv_rec.get("serve/quant_compression"),
         "serve_prefill_chunks": sv_rec.get("serve/prefill_chunks"),
         "serve_sampled_tokens": sv_rec.get("serve/sampled_tokens"),
+        "serve_slo_attainment": sv_rec.get("serve/slo_attainment"),
+        "serve_slo_coverage": sv_result["slo_attribution"].get(
+            "span_coverage"
+        ),
         "numerics": "ok" if numerics_ok else "FAILED",
         "numerics_provenance": nm_rec.get("numerics/provenance_name"),
         "numerics_diff_aligned": diff_report.get("aligned_steps"),
@@ -682,6 +725,12 @@ def serve_only() -> int:
         ),
         "chunk_spans": res["chunk_spans"],
         "long_request_tokens": res["long_tokens"],
+        "serve_slo_attainment": res["record"].get("serve/slo_attainment"),
+        "serve_slo_attribution": {
+            k: res["slo_attribution"].get(k)
+            for k in ("queue_wait_s", "prefill_blocked_s",
+                      "decode_contention_s", "e2e_s", "span_coverage")
+        },
         "trace_requests": sorted(res["spans_by_rid"]),
     }))
     return 0 if res["ok"] else 1

@@ -44,6 +44,7 @@ from stoke_tpu.configs import (
     StokeOptimizer,
 )
 from stoke_tpu.serving.sampling import SamplingParams
+from stoke_tpu.serving.slo import RequestSLO
 from stoke_tpu.data import (
     ArrayDataset,
     BucketedDistributedSampler,
@@ -111,6 +112,7 @@ __all__ = [
     "ResilienceConfig",
     "ServeConfig",
     "SamplingParams",
+    "RequestSLO",
     "TelemetryConfig",
     "TensorboardConfig",
     "TraceConfig",

@@ -95,6 +95,7 @@ JSONL_EMITTER_MODULES: Tuple[str, ...] = (
     "stoke_tpu/telemetry/numerics.py",
     "stoke_tpu/resilience.py",
     "stoke_tpu/serving/telemetry.py",
+    "stoke_tpu/serving/slo.py",
 )
 #: emitter function names the JSONL rule inspects
 _JSONL_EMITTER_FNS = ("event_fields", "_event_fields", "_base_event_fields")

@@ -1385,6 +1385,14 @@ class ServeConfig:
             token cap).
         log_every_n_steps: engine iterations between serve telemetry
             records (JSONL ``serve/*`` fields + gauge refresh).
+        slo_ttft_target_s / slo_tpot_target_s: default SLO deadlines
+            (ISSUE 16) for requests that carry a ``RequestSLO`` without
+            their own targets — TTFT is arrival → first token (queue
+            time included), TPOT the mean decode-token interval.  Both
+            ``None`` by default: requests without a ``RequestSLO`` are
+            never SLO-tracked, and an engine that sees none emits zero
+            ``serve/slo_*`` JSONL fields with program HLO bit-identical
+            to pre-ISSUE-16 (the tracker is purely host-side).
     """
 
     max_seqs: int = 8
@@ -1410,6 +1418,8 @@ class ServeConfig:
     quant_min_size: int = 1024
     eos_id: Optional[int] = None
     log_every_n_steps: int = 8
+    slo_ttft_target_s: Optional[float] = None
+    slo_tpot_target_s: Optional[float] = None
 
 
 @dataclass

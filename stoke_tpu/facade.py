@@ -1489,7 +1489,10 @@ class Stoke:
         (per-span-name counts, total and self seconds, and the ranked
         ``critical_path`` — host spans are serial, so the top self-time
         entries are where the host wall clock went).  None without a
-        ``TraceConfig``."""
+        ``TraceConfig``.  A nonzero ``trace/dropped_total`` key means the
+        bounded ring evicted spans — the window describes the RECENT
+        tail, and any span-derived walk (critical path, serve SLO
+        attribution) is partial, not complete."""
         if self._tracer is None:
             return None
         return self._tracer.summary()
@@ -2975,6 +2978,15 @@ class Stoke:
         programs do.  The config's presence alone changes NOTHING about
         training (it is only read here; tests assert step-program HLO
         bit-identity).
+
+        SLOs (ISSUE 16): ``engine.submit(..., slo=RequestSLO(...))``
+        tags requests with a priority class + TTFT/TPOT deadlines
+        (defaults from ``ServeConfig.slo_ttft_target_s`` /
+        ``slo_tpot_target_s``); ``engine.summary()["slo"]`` then carries
+        per-class attainment, goodput-under-SLO tokens/s, and queue-ETA
+        forecasts, and ``engine.slo.attributions`` the span-walked
+        queue/prefill/decode violation buckets (docs/serving.md, "SLOs &
+        priority classes").
 
         Params note: the engine reads the facade's LIVE params.  The
         int8/bf16 quantized stores copy into their own (smaller) buffers;

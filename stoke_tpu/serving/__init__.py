@@ -12,6 +12,9 @@ The inference vertical behind ``Stoke.serve()``:
   sampling with per-request seeded key streams (ISSUE 13);
 - :mod:`~stoke_tpu.serving.telemetry` — TTFT/TPOT histograms + p50/p99
   gauges, capacity gauges, queue/prefill/decode goodput buckets;
+- :mod:`~stoke_tpu.serving.slo` — per-request deadlines + priority
+  classes: attainment fractions, goodput-under-SLO, queue-ETA
+  forecasts, span-walked violation attribution (ISSUE 16);
 - :mod:`~stoke_tpu.serving.engine` — the prefill/decode-split engine
   wiring it all to the compiled programs and the PR-6 AOT ledger.
 
@@ -38,12 +41,20 @@ from stoke_tpu.serving.sampling import (
     validate_sampling_params,
 )
 from stoke_tpu.serving.scheduler import Request, Scheduler
+from stoke_tpu.serving.slo import (
+    RequestSLO,
+    SLOTracker,
+    validate_request_slo,
+)
 from stoke_tpu.serving.telemetry import ServeMetrics
 
 __all__ = [
     "SamplingParams",
     "sample_tokens",
     "validate_sampling_params",
+    "RequestSLO",
+    "SLOTracker",
+    "validate_request_slo",
     "ServingEngine",
     "PagedKVCache",
     "PagedAttentionHook",

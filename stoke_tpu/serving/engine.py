@@ -70,9 +70,16 @@ from stoke_tpu.serving.sampling import (
     validate_sampling_params,
 )
 from stoke_tpu.serving.scheduler import Request, Scheduler
+from stoke_tpu.serving.slo import (
+    RequestSLO,
+    SLOTracker,
+    resolve_request_slo,
+)
 from stoke_tpu.serving.telemetry import ServeMetrics
 from stoke_tpu.telemetry.registry import MetricsRegistry
 from stoke_tpu.telemetry.tracing import (
+    dropped_total,
+    request_spans,
     trace_add,
     trace_point,
     trace_span,
@@ -172,6 +179,11 @@ class ServingEngine:
                 else MetricsRegistry()
             )
         )
+        # SLO observatory (ISSUE 16): purely host-side — never enters a
+        # dispatch argument list, so the compiled programs are identical
+        # with and without it; inert (zero instruments, zero JSONL
+        # fields) until the first SLO-tagged request arrives
+        self.slo = SLOTracker(self.metrics.registry)
 
         size = BERT_SIZES[model.size_name]
         self._heads = size.heads
@@ -522,6 +534,7 @@ class ServingEngine:
         max_new_tokens: Optional[int] = None,
         eos_id: Optional[int] = None,
         sampling: Optional[SamplingParams] = None,
+        slo: Optional[RequestSLO] = None,
     ) -> int:
         """Enqueue one request (mid-flight is the point); returns its id.
 
@@ -533,6 +546,13 @@ class ServingEngine:
         the config's default knobs; a request without an explicit seed
         gets the deterministic per-request default
         ``sampling_seed + rid``, so whole runs replay from the config.
+
+        ``slo`` (ISSUE 16) carries the request's priority class and
+        TTFT/TPOT deadlines — same contract: validated here, never
+        mid-decode, unset targets resolved from the
+        ``ServeConfig.slo_ttft_target_s`` / ``slo_tpot_target_s``
+        defaults.  Purely host-side accounting; the compiled programs
+        never see it.
         """
         if sampling is not None:
             if not self._sampling:
@@ -545,12 +565,20 @@ class ServingEngine:
             params = sampling
         else:
             params = self._default_sampling
+        if slo is not None:
+            slo = resolve_request_slo(
+                slo, self.cfg.slo_ttft_target_s, self.cfg.slo_tpot_target_s
+            )
         # the scheduler resolves the seed beside the rid it assigns
         # (explicit params.seed wins, else sampling_seed + rid)
         rid = self.scheduler.submit(
-            prompt, max_new_tokens, eos_id, params=params
+            prompt, max_new_tokens, eos_id, params=params, slo=slo
         )
         self.metrics.requests.inc()
+        if slo is not None:
+            # the queue tail IS the request just enqueued (single-threaded
+            # intake; the scheduler appends before returning the rid)
+            self.slo.on_submit(self.scheduler.queue[-1])
         return rid
 
     def result(self, rid: int) -> Optional[Request]:
@@ -689,6 +717,8 @@ class ServingEngine:
                     track="serve", request_id=req.rid,
                     attrs={"prompt_len": plen}, count_self=False,
                 )
+            if req.slo is not None:
+                self.slo.on_admit(req)
             if self._sampling or self._chunk_jit is not None:
                 self._key_data[slot] = initial_key_data(req.seed)
             if padded is None:
@@ -836,6 +866,13 @@ class ServingEngine:
         tpot = req.tpot_s
         if tpot is not None:
             m.observe_tpot(tpot)
+        if req.slo is not None:
+            # finalize attainment + re-walk the request's span timeline
+            # into the violation-attribution buckets (ISSUE 16); a ring
+            # that dropped spans marks the attribution partial
+            self.slo.on_finish(
+                req, request_spans(req.rid), dropped_total()
+            )
         if self._telemetry is not None:
             self._telemetry.add_tokens(len(req.tokens))
 
@@ -853,6 +890,7 @@ class ServingEngine:
         )
         if target > m.queue_s.value:
             m.queue_s.inc(target - m.queue_s.value)
+        self.slo.refresh_gauges()
 
     def emit_record(self) -> Optional[dict]:
         """Write one JSONL serve record through the telemetry pipeline
@@ -863,10 +901,16 @@ class ServingEngine:
         self._last_emit_iter = self._iterations
         if self._telemetry is None or not self._telemetry.enabled:
             return None
+        # the serve/slo_* block is conditional: {} until the first
+        # SLO-tagged request, so an SLO-free engine's records carry zero
+        # new fields (build_step_event honors the omission)
         return self._telemetry.record_step(
             step=self._iterations,
             window_steps=window,
-            serve=self.metrics.event_fields(),
+            serve={
+                **self.metrics.event_fields(),
+                **self.slo.event_fields(),
+            },
         )
 
     # ------------------------------------------------------------------ #
@@ -900,4 +944,8 @@ class ServingEngine:
                 "prefill": m.prefill_s.value,
                 "decode": m.decode_s.value,
             },
+            # SLO observatory (ISSUE 16): {"active": False} until an
+            # SLO-tagged request arrives, else per-class attainment,
+            # goodput-under-SLO, and queue-ETA forecasts
+            "slo": self.slo.summary(),
         }

@@ -38,6 +38,7 @@ import numpy as np
 from stoke_tpu.native import NativeBatcher
 from stoke_tpu.serving.kv_cache import SCRATCH_BLOCK, BlockAllocator
 from stoke_tpu.serving.sampling import SamplingParams
+from stoke_tpu.serving.slo import RequestSLO
 
 
 @dataclass
@@ -49,7 +50,9 @@ class Request:
     and the per-token deltas after it feed the TTFT/TPOT histograms.
     ``params``/``seed`` are the resolved sampling knobs (ISSUE 13): the
     engine resolves defaults at submit, so the scheduler only carries
-    them.
+    them.  ``slo`` is the resolved per-request SLO (ISSUE 16), same
+    contract: targets already filled from the ServeConfig defaults, the
+    scheduler never interprets it.
     """
 
     rid: int
@@ -58,6 +61,7 @@ class Request:
     eos_id: Optional[int] = None
     params: SamplingParams = field(default_factory=SamplingParams)
     seed: int = 0
+    slo: Optional[RequestSLO] = None
     arrival_ts: float = field(default_factory=time.perf_counter)
     admit_ts: Optional[float] = None
     first_token_ts: Optional[float] = None
@@ -144,6 +148,7 @@ class Scheduler:
         max_new_tokens: Optional[int] = None,
         eos_id: Optional[int] = None,
         params: Optional[SamplingParams] = None,
+        slo: Optional[RequestSLO] = None,
     ) -> int:
         """Enqueue one request; returns its id.  Requests whose worst case
         cannot fit ``max_seq_len`` are rejected here — a cap the paged
@@ -183,6 +188,7 @@ class Scheduler:
                 eos_id=self.eos_id if eos_id is None else eos_id,
                 params=params,
                 seed=int(seed),
+                slo=slo,
             )
         )
         return rid

@@ -1106,6 +1106,17 @@ class StokeStatus:
                         f"tokens incl. the reserved scratch block 0) — no "
                         f"request could ever be admitted"
                     )
+            for field in ("slo_ttft_target_s", "slo_tpot_target_s"):
+                v = getattr(cfg, field)
+                if v is not None and not v > 0.0:
+                    # a non-positive deadline is violated before the
+                    # request even arrives — reject with the remedy, not
+                    # a 100%-violation dashboard mystery (ISSUE 16)
+                    return (
+                        f"ServeConfig.{field} must be > 0 seconds when "
+                        f"set, got {v} (None = requests carry their own "
+                        f"RequestSLO targets)"
+                    )
             return False
 
         def _remat_invalid(s):

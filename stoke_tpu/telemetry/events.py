@@ -194,6 +194,26 @@ STEP_EVENT_FIELDS: Dict[str, tuple] = {
     # unchunked engine — the fields still ride every serve record)
     "serve/prefill_chunks": (False, "nullable_number"),
     "serve/sampled_tokens": (False, "nullable_number"),
+    # SLO observatory (ISSUE 16; keys absent until a request carries a
+    # RequestSLO — an SLO-free engine's records are byte-identical to
+    # pre-ISSUE-16 ones): submitted/finished/violated counts over
+    # SLO-tagged requests, TTFT/TPOT/overall attainment fractions (null
+    # before the first SLO-tagged finish), goodput under SLO (tokens/s
+    # from requests that met their deadline — the arXiv:2605.25645
+    # measuring stick), the pooled queue-ETA forecast (median admission
+    # wait), min TTFT deadline headroom over in-flight requests (null
+    # when none is awaiting its first token; negative = busted), and the
+    # count of attributions degraded by a truncated/inactive span ring
+    "serve/slo_requests": (False, "nullable_number"),
+    "serve/slo_finished": (False, "nullable_number"),
+    "serve/slo_violations": (False, "nullable_number"),
+    "serve/slo_ttft_attainment": (False, "nullable_number"),
+    "serve/slo_tpot_attainment": (False, "nullable_number"),
+    "serve/slo_attainment": (False, "nullable_number"),
+    "serve/slo_goodput_tokens_per_s": (False, "nullable_number"),
+    "serve/slo_queue_eta_s": (False, "nullable_number"),
+    "serve/slo_headroom_min_s": (False, "nullable_number"),
+    "serve/slo_partial_attributions": (False, "nullable_number"),
     # per-layer numerics observatory (ISSUE 12; keys absent without a
     # NumericsConfig): groups is the fixed group count of the run's param
     # tree; per_group the nullable {group: {stat: value}} block (grad/
@@ -239,6 +259,14 @@ RESILIENCE_STEP_FIELDS = tuple(
 #: ``serve=`` dict; ServeMetrics.event_fields must match)
 SERVE_STEP_FIELDS = tuple(
     f for f in STEP_EVENT_FIELDS if f.startswith("serve/")
+)
+
+#: the SLO subset (ISSUE 16): emitted ONLY once a request carries a
+#: RequestSLO — the tracker omits these keys from its block otherwise,
+#: and ``build_step_event`` honors the omission, so an SLO-free engine
+#: adds zero JSONL fields (the FLEET_REBALANCE_FIELDS discipline)
+SERVE_SLO_FIELDS = tuple(
+    f for f in SERVE_STEP_FIELDS if f.startswith("serve/slo_")
 )
 
 #: the per-layer-numerics subset (populated via ``build_step_event``'s
@@ -508,6 +536,10 @@ def build_step_event(
         # serving fields (ISSUE 9): keys appear only when a ServingEngine
         # emits the record — a training run's JSONL never carries them
         for key in SERVE_STEP_FIELDS:
+            if key in SERVE_SLO_FIELDS and key not in serve:
+                # SLO keys ride only once a request carried a RequestSLO
+                # (ISSUE 16 default-OFF contract: zero new JSONL fields)
+                continue
             value = serve.get(key)
             record[key] = None if value is None else _round(float(value))
         unknown = set(serve) - set(SERVE_STEP_FIELDS)

@@ -374,6 +374,10 @@ class TraceRecorder:
         return {
             "spans": len(spans),
             "dropped": self.dropped,
+            # registry-name alias (ISSUE 16): a truncated ring must not
+            # masquerade as a complete critical path — dashboards keyed on
+            # the counter name read the same figure off the summary
+            "trace/dropped_total": self.dropped,
             "tracks": sorted({s.track for s in spans}),
             "window_self_s": total_self,
             "by_name": by_name,
@@ -550,3 +554,24 @@ def tracing_active() -> bool:
     """True when at least one recorder is registered (serving uses this to
     skip per-request slice bookkeeping entirely when tracing is off)."""
     return bool(_RECORDERS)
+
+
+def request_spans(request_id) -> List[Span]:
+    """Every ringed span tagged with ``request_id`` across the registered
+    recorders — the SLO violation attribution (ISSUE 16) re-walks a
+    finished request's timeline through this.  Empty when tracing is off
+    (the attribution then reports span coverage ``"none"``, never a
+    vacuously-complete walk)."""
+    if not _RECORDERS:
+        return []
+    out: List[Span] = []
+    for rec in list(_RECORDERS):
+        out.extend(s for s in rec.spans() if s.request_id == request_id)
+    return out
+
+
+def dropped_total() -> int:
+    """Spans evicted across the registered recorders' rings.  Nonzero
+    means any span-derived walk (critical path, SLO attribution) may be
+    missing intervals and must report itself partial."""
+    return sum(rec.dropped for rec in list(_RECORDERS))

@@ -249,3 +249,66 @@ def test_emit_persisted_default_run_refuses_fastpath_record(ledger, capsys):
                    "serve_long_prompt": False},
     )
     assert rc == 0 and out["value"] == 1000.0
+
+
+def test_emit_persisted_priority_mix_guard_is_symmetric(ledger, capsys):
+    """ISSUE 16 satellite: the serve_priority_mix config key follows the
+    serve_long_prompt pattern — a mix capture is never substituted for a
+    default (untagged) run, and a default (pre-SLO, keyless) record still
+    satisfies a default request."""
+    # direction 1: a priority-mix capture never satisfies a default run
+    bench.persist_result(
+        "gpt_small_serve_throughput",
+        {"value": 2000.0, "date": "2026-08-06", "backend": "tpu",
+         "serve": True, "serve_priority_mix": True,
+         "slo_attainment_interactive": 0.9},
+    )
+    rc, out = _emit(
+        capsys, "gpt_small_serve_throughput",
+        requested={"serve_priority_mix": False},
+    )
+    assert rc == 1
+    assert "serve_priority_mix" in out["error"]
+    # direction 2: a default (untagged) record never satisfies a mix run
+    bench.persist_result(
+        "gpt_small_serve_throughput",
+        {"value": 1000.0, "date": "2026-07-01", "backend": "tpu",
+         "serve": True},
+    )
+    rc, out = _emit(
+        capsys, "gpt_small_serve_throughput",
+        requested={"serve_priority_mix": True},
+    )
+    assert rc == 1
+    assert "serve_priority_mix" in out["error"]
+    # and a legacy keyless record satisfies a default request (absent
+    # normalizes to False — pre-ISSUE-16 serve traces carried no SLOs)
+    rc, out = _emit(
+        capsys, "gpt_small_serve_throughput",
+        requested={"serve_priority_mix": False},
+    )
+    assert rc == 0 and out["value"] == 1000.0
+
+
+def test_emit_persisted_slo_columns_ride_stale_emit(ledger, capsys):
+    """A re-cited priority-mix capture carries its per-class attainment
+    and goodput-under-SLO columns, so consumers of the stale number see
+    the SLO verdict it measured."""
+    bench.persist_result(
+        "gpt_small_serve_throughput",
+        {"value": 1500.0, "unit": "tokens/sec", "date": "2026-08-06",
+         "backend": "tpu", "serve": True, "serve_priority_mix": True,
+         "slo_attainment_interactive": 0.875, "slo_attainment_batch": 1.0,
+         "slo_goodput_tokens_per_s": 1400.0,
+         "slo_goodput_tokens_per_s_interactive": 700.0,
+         "slo_goodput_tokens_per_s_batch": 700.0},
+    )
+    rc, out = _emit(
+        capsys, "gpt_small_serve_throughput",
+        requested={"serve_priority_mix": True},
+    )
+    assert rc == 0
+    assert out["serve_priority_mix"] is True
+    assert out["slo_attainment_interactive"] == 0.875
+    assert out["slo_attainment_batch"] == 1.0
+    assert out["slo_goodput_tokens_per_s"] == 1400.0

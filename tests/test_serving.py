@@ -1178,15 +1178,20 @@ def test_greedy_engine_programs_carry_no_sampling_plumbing(rng):
 def test_serve_event_fields_match_schema():
     """ServeMetrics.event_fields and the JSONL schema's serve/* block are
     ONE wire format — the new prefill_chunks/sampled_tokens fields ride
-    both."""
-    from stoke_tpu.telemetry.events import SERVE_STEP_FIELDS
+    both.  The serve/slo_* fields (ISSUE 16) are the schema's nullable
+    tail: SLOTracker emits them only once a deadline-tagged request
+    exists, so ServeMetrics alone covers exactly the non-SLO slice."""
+    from stoke_tpu.telemetry.events import (
+        SERVE_SLO_FIELDS,
+        SERVE_STEP_FIELDS,
+    )
     from stoke_tpu.telemetry.registry import MetricsRegistry
 
     from stoke_tpu.serving.telemetry import ServeMetrics
 
     m = ServeMetrics(MetricsRegistry())
     fields = m.event_fields()
-    assert set(fields) == set(SERVE_STEP_FIELDS)
+    assert set(fields) == set(SERVE_STEP_FIELDS) - set(SERVE_SLO_FIELDS)
     assert "serve/prefill_chunks" in fields
     assert "serve/sampled_tokens" in fields
 

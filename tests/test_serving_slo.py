@@ -1,0 +1,382 @@
+"""Serve SLO observatory tests (ISSUE 16).
+
+Per-request deadlines + priority classes over the continuous-batching
+engine: submit-time validation, per-class attainment / goodput-under-SLO
+accounting, span-walked violation attribution whose buckets provably sum
+to the measured end-to-end latency, and the default-OFF discipline — an
+engine that never sees an SLO request emits zero new JSONL fields and
+its serve programs lower to bit-identical HLO.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from stoke_tpu.configs import ServeConfig
+from stoke_tpu.models.gpt import GPT
+from stoke_tpu.serving import RequestSLO, ServingEngine, SLOTracker
+from stoke_tpu.serving.scheduler import Request
+from stoke_tpu.serving.slo import (
+    attribute_request,
+    resolve_request_slo,
+    validate_request_slo,
+)
+from stoke_tpu.status import StokeStatus, StokeValidationError
+from stoke_tpu.telemetry.registry import MetricsRegistry
+from stoke_tpu.telemetry.tracing import (
+    TraceRecorder,
+    register_recorder,
+    unregister_recorder,
+)
+from stoke_tpu.utils import init_module
+
+pytestmark = pytest.mark.serving
+
+VOCAB = 257
+
+
+def _gpt(max_len: int = 128):
+    model = GPT(
+        vocab_size=VOCAB, size_name="tiny", max_len=max_len,
+        dropout_rate=0.0,
+    )
+    variables = init_module(
+        model, jax.random.PRNGKey(0), np.zeros((1, 8), np.int32),
+        train=False,
+    )
+    return model, variables["params"]
+
+
+def _cfg(**kw):
+    base = dict(
+        max_seqs=4, kv_block_size=8, max_seq_len=64, max_new_tokens=4,
+        prefill_pad_multiple=16,
+    )
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+@pytest.fixture
+def recorder():
+    rec = TraceRecorder(ring_size=4096, output_dir="unused")
+    register_recorder(rec)
+    yield rec
+    unregister_recorder(rec)
+
+
+def _finished_request(rid, priority="default", ttft=1.0, tpot=1.0, *,
+                      arrival=0.0, admit=0.1, first=0.3, finish=0.9,
+                      tokens=(1, 2, 3, 4)):
+    req = Request(
+        rid=rid, prompt=np.array([1, 2, 3], np.int32), max_new_tokens=4,
+        slo=RequestSLO(priority=priority, ttft_target_s=ttft,
+                       tpot_target_s=tpot),
+        arrival_ts=arrival,
+    )
+    req.admit_ts = admit
+    req.first_token_ts = first
+    req.finish_ts = finish
+    req.tokens = list(tokens)
+    return req
+
+
+# --------------------------------------------------------------------------- #
+# validation / resolution
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        RequestSLO(priority=""),
+        RequestSLO(priority=3),
+        RequestSLO(ttft_target_s=0.0),
+        RequestSLO(ttft_target_s=-1.0),
+        RequestSLO(tpot_target_s=0.0),
+    ],
+)
+def test_request_slo_validation_rejects(bad):
+    with pytest.raises((ValueError, TypeError)):
+        validate_request_slo(bad)
+
+
+def test_resolve_fills_config_defaults_and_requires_a_deadline():
+    # unset targets resolve from the ServeConfig defaults
+    r = resolve_request_slo(RequestSLO(priority="p"), 0.5, 0.25)
+    assert r.ttft_target_s == 0.5 and r.tpot_target_s == 0.25
+    # explicit targets win over the defaults
+    r = resolve_request_slo(RequestSLO(ttft_target_s=2.0), 0.5, 0.25)
+    assert r.ttft_target_s == 2.0 and r.tpot_target_s == 0.25
+    # an SLO with no deadline anywhere is a tagging mistake, not a no-op
+    with pytest.raises(ValueError, match="no deadline"):
+        resolve_request_slo(RequestSLO(), None, None)
+
+
+def test_engine_submit_rejects_invalid_slo_before_enqueue():
+    model, params = _gpt()
+    eng = ServingEngine(model, params, _cfg())
+    with pytest.raises(ValueError):
+        eng.submit(np.array([1, 2, 3], np.int32), 2,
+                   slo=RequestSLO(ttft_target_s=-1.0))
+    # rejected at intake: nothing enqueued, tracker never activated
+    assert not eng.scheduler.queue
+    assert eng.slo.active is False
+
+
+@pytest.mark.parametrize(
+    "bad", [{"slo_ttft_target_s": 0.0}, {"slo_tpot_target_s": -0.5}]
+)
+def test_status_rejects_nonpositive_slo_defaults(bad):
+    cfg = ServeConfig(max_seqs=2, kv_block_size=8, max_seq_len=64,
+                      prefill_pad_multiple=16, **bad)
+    with pytest.raises(StokeValidationError):
+        StokeStatus(batch_size_per_device=1, configs=[cfg])
+
+
+# --------------------------------------------------------------------------- #
+# tracker accounting (host-side, fabricated lifecycles)
+# --------------------------------------------------------------------------- #
+
+
+def test_tracker_attainment_violations_and_queue_eta():
+    t = SLOTracker(MetricsRegistry())
+    # interactive: one attained, one TTFT violation
+    ok = _finished_request(0, "interactive", ttft=0.5, tpot=1.0)
+    late = _finished_request(1, "interactive", ttft=0.1, tpot=1.0)
+    # batch: attained with room to spare
+    bulk = _finished_request(2, "batch", ttft=10.0, tpot=10.0)
+    for req in (ok, late, bulk):
+        t.on_submit(req)
+        t.on_admit(req)
+        t.on_finish(req, spans=[], dropped=0)
+    s = t.summary()
+    assert s["active"] is True
+    inter = s["by_class"]["interactive"]
+    assert inter["finished"] == 2
+    assert inter["attained"] == 1 and inter["violated"] == 1
+    assert inter["ttft_attainment"] == 0.5
+    assert s["by_class"]["batch"]["attainment"] == 1.0
+    # queue ETA: every fabricated wait is 0.1s, so the p50 forecast is too
+    assert inter["queue_eta_s"] == pytest.approx(0.1)
+    assert t.queue_eta_s() == pytest.approx(0.1)
+    # goodput counts only attained requests' tokens: 4 (interactive ok)
+    # + 4 (batch) = 8 over the 2s window
+    assert inter["goodput_tokens"] == 4
+    assert s["by_class"]["batch"]["goodput_tokens"] == 4
+    assert t.goodput_tokens_per_s(now=t._t0 + 2.0) == pytest.approx(4.0)
+
+
+def test_tracker_tpot_vacuous_when_single_token():
+    t = SLOTracker(MetricsRegistry())
+    # one generated token => no TPOT sample; only the TTFT deadline binds
+    req = _finished_request(0, ttft=1.0, tpot=1e-9, tokens=(7,))
+    t.on_submit(req)
+    t.on_admit(req)
+    attr = t.on_finish(req, spans=[], dropped=0)
+    assert attr["tpot_s"] is None and attr["tpot_ok"] is True
+    assert attr["attained"] is True
+
+
+def test_tracker_headroom_tracks_inflight_ttft_budget():
+    t = SLOTracker(MetricsRegistry())
+    req = Request(
+        rid=0, prompt=np.array([1], np.int32), max_new_tokens=2,
+        slo=RequestSLO(ttft_target_s=1.0), arrival_ts=100.0,
+    )
+    t.on_submit(req)
+    assert t.headroom_min_s(now=100.4) == pytest.approx(0.6)
+    # past the deadline the headroom goes negative — the gauge's point
+    assert t.headroom_min_s(now=101.5) == pytest.approx(-0.5)
+    req.admit_ts = 100.2
+    req.first_token_ts = 100.5
+    req.finish_ts = 100.9
+    req.tokens = [1, 2]
+    t.on_finish(req, spans=[], dropped=0)
+    assert t.headroom_min_s(now=102.0) is None
+
+
+# --------------------------------------------------------------------------- #
+# violation attribution: buckets sum to e2e, span cross-check
+# --------------------------------------------------------------------------- #
+
+
+def test_attribution_buckets_sum_exactly_without_spans():
+    req = _finished_request(0, arrival=0.0, admit=0.25, first=0.75,
+                            finish=2.0)
+    out = attribute_request(req, spans=[], dropped=0)
+    assert out["queue_wait_s"] == pytest.approx(0.25)
+    assert out["prefill_blocked_s"] == pytest.approx(0.5)
+    assert out["decode_contention_s"] == pytest.approx(1.25)
+    total = (out["queue_wait_s"] + out["prefill_blocked_s"]
+             + out["decode_contention_s"])
+    assert total == pytest.approx(out["e2e_s"], abs=1e-12)
+    # no spans: timestamp buckets stand, but the attribution says so
+    assert out["span_coverage"] == "none" and out["partial"] is True
+
+
+def test_engine_attribution_full_coverage_sums_to_e2e(recorder):
+    """Acceptance: a traced request's span-walked attribution has full
+    coverage and its queue/prefill/decode buckets sum to the measured
+    end-to-end latency — including a CHUNKED prefill request (the
+    serve/prefill_chunk spans count as prefill activity)."""
+    model, params = _gpt()
+    eng = ServingEngine(
+        model, params,
+        _cfg(prefill_chunk_tokens=16, sampling=True, max_seq_len=64),
+    )
+    rng = np.random.default_rng(0)
+    short = eng.submit(
+        rng.integers(1, VOCAB, size=7).astype(np.int32), 3,
+        slo=RequestSLO(priority="interactive",
+                       ttft_target_s=120.0, tpot_target_s=120.0),
+    )
+    chunked = eng.submit(
+        rng.integers(1, VOCAB, size=40).astype(np.int32), 3,
+        slo=RequestSLO(priority="batch",
+                       ttft_target_s=120.0, tpot_target_s=120.0),
+    )
+    eng.run()
+    for rid in (short, chunked):
+        out = eng.slo.attributions[rid]
+        total = (out["queue_wait_s"] + out["prefill_blocked_s"]
+                 + out["decode_contention_s"])
+        assert total == pytest.approx(out["e2e_s"], abs=1e-9)
+        assert out["span_coverage"] == "full"
+        assert out["partial"] is False
+        assert out["prefill_active_s"] > 0.0
+        assert out["decode_active_s"] > 0.0
+        assert out["attained"] is True
+    assert eng.slo.partial_attributions == 0
+    assert eng.summary()["slo"]["attainment"] == 1.0
+
+
+def test_dropped_spans_mark_attribution_partial():
+    """Satellite 2: attribution over an evicting ring reports itself
+    PARTIAL — a truncated timeline never masquerades as full coverage."""
+    rec = TraceRecorder(ring_size=4, output_dir="unused")
+    register_recorder(rec)
+    try:
+        model, params = _gpt()
+        eng = ServingEngine(model, params, _cfg())
+        rid = eng.submit(
+            np.array([5, 6, 7], np.int32), 3,
+            slo=RequestSLO(ttft_target_s=120.0),
+        )
+        eng.run()
+        assert rec.dropped > 0  # a 4-slot ring must have evicted
+        out = eng.slo.attributions[rid]
+        assert out["partial"] is True
+        assert eng.slo.partial_attributions == 1
+        # the buckets themselves stay exact — they come from the request's
+        # own timestamps, not the (truncated) spans
+        total = (out["queue_wait_s"] + out["prefill_blocked_s"]
+                 + out["decode_contention_s"])
+        assert total == pytest.approx(out["e2e_s"], abs=1e-9)
+    finally:
+        unregister_recorder(rec)
+
+
+# --------------------------------------------------------------------------- #
+# default-OFF: zero new fields, bit-identical serve programs
+# --------------------------------------------------------------------------- #
+
+
+def _run_one(eng):
+    rid = eng.submit(np.array([3, 1, 4, 1, 5], np.int32), 3)
+    eng.run()
+    return list(eng.scheduler.finished[rid].tokens)
+
+
+def _jsonl_record(eng):
+    """The serve JSONL record exactly as emit_record builds it (without
+    attaching a full telemetry pipeline): ServeMetrics + SLOTracker
+    fields through the schema builder."""
+    from stoke_tpu.telemetry.events import build_step_event
+
+    return build_step_event(
+        ts=0.0, step=1, rank=0, window_steps=1, host_dispatch_s=0.0,
+        loader_wait_s=0.0, samples_total=1.0, compiles_total=0,
+        recompiles=0, compile_time_s=0.0,
+        serve={**eng.metrics.event_fields(), **eng.slo.event_fields()},
+    )
+
+
+def _program_hlo(eng, program):
+    from stoke_tpu.analysis import normalize_module_name
+
+    spec = next(s for s in eng.audit_specs() if s.program == program)
+    return normalize_module_name(
+        spec.fn.lower(*spec.abstract_args).as_text()
+    )
+
+
+def test_slo_free_engine_emits_zero_new_fields_and_identical_hlo():
+    """Acceptance: without an SLO request the JSONL record carries NO
+    serve/slo_* key (absent, not null), and an engine constructed with
+    SLO defaults configured lowers bit-identical serve programs — the
+    tracker is host-side bookkeeping the compiled graphs never see."""
+    model, params = _gpt()
+    plain = ServingEngine(model, params, _cfg())
+    tagged = ServingEngine(
+        model, params,
+        _cfg(slo_ttft_target_s=0.001, slo_tpot_target_s=0.001),
+    )
+    toks_plain = _run_one(plain)
+    toks_tagged = _run_one(tagged)  # still no RequestSLO: tracker stays off
+    assert toks_plain == toks_tagged
+    for eng in (plain, tagged):
+        rec = _jsonl_record(eng)
+        assert not any(k.startswith("serve/slo_") for k in rec)
+        assert eng.summary()["slo"] == {"active": False}
+    for program in ("serve_prefill", "serve_decode"):
+        assert _program_hlo(plain, program) == _program_hlo(tagged, program)
+
+
+def test_slo_fields_appear_only_after_first_slo_request():
+    model, params = _gpt()
+    eng = ServingEngine(model, params, _cfg())
+    _run_one(eng)
+    assert not any(k.startswith("serve/slo_") for k in _jsonl_record(eng))
+    rid = eng.submit(
+        np.array([9, 8, 7], np.int32), 3,
+        slo=RequestSLO(priority="interactive", ttft_target_s=120.0),
+    )
+    eng.run()
+    rec = _jsonl_record(eng)
+    assert rec["serve/slo_requests"] == 1
+    assert rec["serve/slo_attainment"] == 1.0
+    assert rid in eng.slo.attributions
+
+
+def test_slo_event_fields_round_trip_the_jsonl_schema():
+    """SLOTracker.event_fields and the schema's serve/slo_* block are ONE
+    wire format, and build_step_event skips the fields (absent, never
+    null) until the tracker activates."""
+    from stoke_tpu.telemetry.events import (
+        SERVE_SLO_FIELDS,
+        build_step_event,
+        validate_step_event,
+    )
+
+    t = SLOTracker(MetricsRegistry())
+    assert t.event_fields() == {}  # inactive: zero fields
+    req = _finished_request(0, "interactive")
+    t.on_submit(req)
+    t.on_admit(req)
+    t.on_finish(req, spans=[], dropped=0)
+    fields = t.event_fields()
+    assert set(fields) == set(SERVE_SLO_FIELDS)
+    base = dict(
+        ts=0.0, step=1, rank=0, window_steps=1, host_dispatch_s=0.0,
+        loader_wait_s=0.0, samples_total=1.0, compiles_total=0,
+        recompiles=0, compile_time_s=0.0,
+    )
+    without = build_step_event(serve={"serve/completed": 1.0}, **base)
+    assert not any(k.startswith("serve/slo_") for k in without)
+    with_slo = build_step_event(
+        serve={"serve/completed": 1.0, **fields}, **base
+    )
+    validate_step_event(with_slo)
+    assert with_slo["serve/slo_requests"] == 1.0
+    assert with_slo["serve/slo_attainment"] == 1.0

@@ -408,6 +408,9 @@ def test_facade_trace_summary_and_export(tmp_path):
     s.train_step(x, (y,))
     summary = s.trace_summary
     assert summary["spans"] > 0
+    # ISSUE 16 satellite: the eviction count rides the summary under the
+    # same key the registry counter and merge tool use
+    assert summary["trace/dropped_total"] == 0
     # the engine dispatch and the facade phase both landed as spans
     assert "stoke/dispatch" in summary["by_name"]
     assert "stoke/train_step" in summary["by_name"]
@@ -561,3 +564,69 @@ def test_merge_rank_traces_no_common_step(tmp_path):
     _fake_trace(tmp_path / "trace.rank1.json", 1, 0.0, steps=(2,))
     assert mrt.main([str(tmp_path), "--out",
                      str(tmp_path / "m.json")]) == 2
+
+
+# --------------------------------------------------------------------------- #
+# ISSUE 16 satellite: dropped-span surfacing (summary, helpers, merge)
+# --------------------------------------------------------------------------- #
+
+
+def test_recorder_summary_and_module_helpers_surface_dropped():
+    """``TraceRecorder.summary`` carries ``trace/dropped_total`` (the key
+    the facade's trace_summary and the merge tool share), and the
+    module-level ``dropped_total``/``request_spans`` helpers aggregate
+    over every registered recorder — the surfaces the SLO attribution
+    walks."""
+    from stoke_tpu.telemetry.tracing import dropped_total, request_spans
+
+    rec = TraceRecorder(ring_size=4)
+    register_recorder(rec)
+    try:
+        for i in range(10):
+            with rec.span("churn", request_id=i % 2):
+                pass
+        assert rec.summary()["trace/dropped_total"] == rec.dropped == 6
+        assert dropped_total() == 6
+        # request_spans filters the surviving window by request id
+        rids = {s.request_id for s in request_spans(1)}
+        assert rids == {1}
+        assert request_spans(99) == []
+    finally:
+        unregister_recorder(rec)
+    # no registered recorder: unknown coverage reads as zero spans, and
+    # the dropped aggregate is 0 (nothing is recording)
+    assert request_spans(1) == []
+    assert dropped_total() == 0
+
+
+def test_merge_rank_traces_surfaces_dropped_counts(tmp_path, capsys):
+    """The merged report carries per-rank eviction counts and the pod
+    total; a file without exporter metadata (bare chrome-trace) reports
+    ``None`` — unknown is never shown as zero."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    import merge_rank_traces as mrt
+
+    _fake_trace(tmp_path / "trace.rank0.json", 0, 0.0)
+    # rank 1 carries the exporter's metadata block with a nonzero count
+    p1 = tmp_path / "trace.rank1.json"
+    _fake_trace(p1, 1, 5e6)
+    doc = json.load(open(p1))
+    doc["stoke"] = {"rank": 1, "dropped": 7}
+    json.dump(doc, open(p1, "w"))
+    assert mrt.load_dropped(str(p1)) == 7
+    assert mrt.load_dropped(str(tmp_path / "trace.rank0.json")) is None
+    rc = mrt.main([str(tmp_path), "--out", str(tmp_path / "m.json"),
+                   "--json"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["dropped_by_rank"] == {"0": None, "1": 7}
+    assert report["trace/dropped_total"] == 7
+    # human-read mode warns that the merged timeline is partial
+    rc = mrt.main([str(tmp_path), "--out", str(tmp_path / "m2.json")])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "dropped unknown" in out and "dropped 7" in out
+    assert "PARTIAL" in out
