@@ -117,6 +117,9 @@ _SERVE_KEY_DEFAULTS = {
     "serve_long_prompt": False,
     # pre-ISSUE-16 serve records carried no SLO-tagged requests
     "serve_priority_mix": False,
+    # pre-ISSUE-17 serve records were all non-speculative single-token
+    # decode captures
+    "serve_speculative": False,
 }
 
 
@@ -192,7 +195,11 @@ def _emit_persisted(metric: str, capture_error: str,
                         "serve", "serve_quant", "serve_max_seqs",
                         "serve_decode_kernel", "serve_prefill_chunk",
                         "serve_sampling", "serve_long_prompt",
-                        "serve_priority_mix",
+                        "serve_priority_mix", "serve_speculative",
+                        "spec_accept_rate",
+                        "accepted_tokens_per_dispatch",
+                        "effective_tpot_s",
+                        "decode_dispatches", "decode_dispatches_baseline",
                         "tpot_stall_chunked_s", "tpot_stall_unchunked_s",
                         "slo_attainment_interactive",
                         "slo_attainment_batch",
@@ -242,7 +249,7 @@ _REGRESSION_CONFIG_KEYS = (
     "health", "attribution", "fleet", "tuned", "resilience", "trace",
     "numerics", "serve", "serve_quant", "serve_max_seqs",
     "serve_decode_kernel", "serve_prefill_chunk", "serve_sampling",
-    "serve_long_prompt", "serve_priority_mix",
+    "serve_long_prompt", "serve_priority_mix", "serve_speculative",
 )
 
 
@@ -544,6 +551,14 @@ def _serve_bench(args, tiny: bool) -> int:
     # "batch" with loose ones — and report per-class attainment plus
     # goodput-under-SLO tokens/s beside the raw-throughput headline
     mix = bool(args.serve_priority_mix)
+    # speculative arm (ISSUE 17): serve a repetitive-text trace (tiled
+    # n-gram motifs — the workload prompt-lookup drafting exists for)
+    # through the k-token verify programs, and run the SAME trace through
+    # a non-speculative engine as the comparison leg: the headline pair
+    # is accepted-tokens-per-dispatch vs the strictly-greater baseline
+    # dispatch count at equal emitted tokens
+    spec = bool(args.serve_speculative)
+    spec_k = 4
     _MIX_SLOS = (
         RequestSLO(priority="interactive",
                    ttft_target_s=0.5, tpot_target_s=0.1),
@@ -551,7 +566,7 @@ def _serve_bench(args, tiny: bool) -> int:
                    ttft_target_s=10.0, tpot_target_s=1.0),
     )
 
-    def build_engine(chunk_tokens):
+    def build_engine(chunk_tokens, speculative=False):
         cfg = ServeConfig(
             max_seqs=args.serve_max_seqs,
             kv_block_size=16,
@@ -562,18 +577,34 @@ def _serve_bench(args, tiny: bool) -> int:
             quant_min_size=256,
             decode_kernel=args.serve_decode_kernel,
             prefill_chunk_tokens=chunk_tokens,
-            sampling=sampling,
+            # the verify program samples its targets, so the speculative
+            # arm runs the sampling-aware programs even in greedy mode
+            # (temperature 0 keeps the streams argmax-deterministic)
+            sampling=sampling or speculative,
             # the topp arm's knobs: a representative production mix
             temperature=0.8 if sampling else 0.0,
             top_p=0.9 if sampling else None,
+            speculative_k=spec_k if speculative else None,
         )
         return ServingEngine(model, variables["params"], cfg), cfg
 
-    eng, cfg = build_engine(chunk)
+    eng, cfg = build_engine(chunk, speculative=spec)
 
     n = args.serve_requests or (8 if tiny else 48)
     r = np.random.default_rng(0)
-    if long_arm:
+    if spec:
+        # repetitive-text trace: each prompt tiles a short random motif,
+        # so both the prompt window and the model's own (cycling) greedy
+        # continuation are draftable by the n-gram lookup
+        prompts = []
+        for _ in range(n):
+            motif = r.integers(1, vocab, size=int(r.integers(2, 5)))
+            reps = int(r.integers(3, 7))
+            prompts.append(np.tile(motif, reps).astype(np.int32))
+        out_lens = np.full(n, 24)
+        arrivals = np.cumsum(r.exponential(0.02 if tiny else 0.05, size=n))
+        long_prompt = None
+    elif long_arm:
         # one near-max prompt admitted while short requests decode: the
         # worst-case TPOT-stall scenario chunked prefill exists to fix
         long_len = cfg.max_seq_len - 40
@@ -659,7 +690,44 @@ def _serve_bench(args, tiny: bool) -> int:
     # steady-state latency is the claim: drop the warm pass's compile-
     # dominated TTFT/TPOT samples before the measured pass
     eng.metrics.reset_latency_reservoirs()
+    d0 = eng.metrics.decode_steps.value
+    ds0 = eng.metrics.decode_s.value
+    spec0 = (
+        (eng.metrics.spec_draft_tokens.value,
+         eng.metrics.spec_accepted_tokens.value)
+        if spec else (0.0, 0.0)
+    )
     measured = trace_pass(eng, tag_slo=True)
+    decode_dispatches = eng.metrics.decode_steps.value - d0
+    decode_wall_s = eng.metrics.decode_s.value - ds0
+
+    spec_cols = {}
+    if spec:
+        drafted = eng.metrics.spec_draft_tokens.value - spec0[0]
+        accepted = eng.metrics.spec_accepted_tokens.value - spec0[1]
+        # the comparison leg: the SAME trace through a non-speculative
+        # engine — at equal emitted tokens its dispatch count is the
+        # baseline the verify programs are measured against
+        eng_off, _ = build_engine(chunk, speculative=False)
+        trace_pass(eng_off)  # warm
+        b0 = eng_off.metrics.decode_steps.value
+        baseline = trace_pass(eng_off)
+        base_dispatches = eng_off.metrics.decode_steps.value - b0
+        spec_cols = {
+            "spec_accept_rate": round(accepted / max(drafted, 1.0), 4),
+            "accepted_tokens_per_dispatch": round(
+                measured["tokens"] / max(decode_dispatches, 1.0), 4
+            ),
+            # decode wall seconds per EMITTED token: the per-token latency
+            # the verify program buys (the tpot_p* columns describe the
+            # same thing per request; this is the fleet-level mean)
+            "effective_tpot_s": round(
+                decode_wall_s / max(measured["tokens"], 1.0), 6
+            ),
+            "decode_dispatches": int(decode_dispatches),
+            "decode_dispatches_baseline": int(base_dispatches),
+            "baseline_tokens": int(baseline["tokens"]),
+        }
 
     slo_cols = {}
     if mix:
@@ -708,6 +776,7 @@ def _serve_bench(args, tiny: bool) -> int:
         "serve_sampling": args.serve_sampling,
         "serve_long_prompt": True if long_arm else None,
         "serve_priority_mix": True if mix else None,
+        "serve_speculative": True if spec else None,
         **(
             {
                 "tpot_stall_chunked_s": round(measured["tpot_stall_s"], 6),
@@ -716,6 +785,7 @@ def _serve_bench(args, tiny: bool) -> int:
             if long_arm
             else {}
         ),
+        **spec_cols,
         **slo_cols,
         "requests": n,
         "ttft_p50_s": round(pct["ttft_p50_s"], 6),
@@ -749,6 +819,7 @@ def _serve_bench(args, tiny: bool) -> int:
                 "serve_sampling": args.serve_sampling,
                 "serve_long_prompt": True if long_arm else None,
                 "serve_priority_mix": True if mix else None,
+                "serve_speculative": True if spec else None,
             },
         )
         if regression is not None:
@@ -778,6 +849,7 @@ def _serve_bench(args, tiny: bool) -> int:
                 "serve_sampling": args.serve_sampling,
                 "serve_long_prompt": True if long_arm else None,
                 "serve_priority_mix": True if mix else None,
+                "serve_speculative": True if spec else None,
                 **(
                     {
                         "tpot_stall_chunked_s": result[
@@ -790,6 +862,7 @@ def _serve_bench(args, tiny: bool) -> int:
                     if long_arm
                     else {}
                 ),
+                **spec_cols,
                 **slo_cols,
                 "requests": n,
                 "ttft_p50_s": result["ttft_p50_s"],
@@ -990,6 +1063,18 @@ def main():
                     "met their deadlines) beside the raw throughput "
                     "headline.  A distinct configuration for the "
                     "stale-substitution and regression guards")
+    ap.add_argument("--serve-speculative", action="store_true",
+                    help="speculative-decoding arm (ISSUE 17): serve a "
+                    "repetitive-text trace (tiled n-gram motifs) through "
+                    "the self-drafting verify programs (prompt-lookup "
+                    "drafter, k-token verify dispatch, k=4) and the same "
+                    "trace through a non-speculative engine as the "
+                    "comparison leg.  Reports spec_accept_rate, "
+                    "accepted_tokens_per_dispatch, effective_tpot_s, and "
+                    "the decode_dispatches / decode_dispatches_baseline "
+                    "pair (fewer dispatches at equal emitted tokens is "
+                    "what speculation buys).  A distinct configuration "
+                    "for the stale-substitution and regression guards")
     ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
     tuned_rec = None
@@ -1080,6 +1165,9 @@ def main():
                 ),
                 "serve_priority_mix": (
                     bool(args.serve_priority_mix) if args.serve else None
+                ),
+                "serve_speculative": (
+                    bool(args.serve_speculative) if args.serve else None
                 ),
                 "tuned": True if args.tuned else None,
                 "fleet": True if args.fleet else None,

@@ -274,7 +274,12 @@ def _measure_serve_decode(spec: TrialSpec, payload: dict, steps: int,
     ``paged_decode_attention_pallas`` at the spec's block knobs over a
     synthetic full block pool — the decode-attention dispatch isolated
     from the rest of the serve loop, so the sweep scores exactly what the
-    knobs move (the HBM→VMEM streaming schedule).  CPU trials run the
+    knobs move (the HBM→VMEM streaming schedule).  With ``spec_k`` in the
+    payload (``--spec-k``, ISSUE 17) the trial measures the k-token
+    verify kernel instead — ``paged_verify_attention_pallas`` at the
+    spec's ``verify_pages_per_block`` / ``verify_block_h`` over S=k+1
+    query rows per sequence, scored as candidate tokens per second (each
+    dispatch scores S positions per slot).  CPU trials run the
     interpreter on tiny shapes (flow validation only); real sweeps run on
     the chip under the tunnel lock like every other workload."""
     import numpy as np
@@ -282,9 +287,13 @@ def _measure_serve_decode(spec: TrialSpec, payload: dict, steps: int,
     import jax
     import jax.numpy as jnp
 
-    from stoke_tpu.ops.flash_attention import paged_decode_attention_pallas
+    from stoke_tpu.ops.flash_attention import (
+        paged_decode_attention_pallas,
+        paged_verify_attention_pallas,
+    )
 
     on_cpu = jax.default_backend() == "cpu"
+    spec_k = payload.get("spec_k")
     # geometry: a full decode batch over a GPT-small-class cache on chip;
     # a toy pool under the interpreter
     B, H, D, BS = (2, 2, 16, 8) if on_cpu else (8, 8, 64, 16)
@@ -299,29 +308,48 @@ def _measure_serve_decode(spec: TrialSpec, payload: dict, steps: int,
     )
     # ragged contexts keep the masked tail honest (the serve batch is
     # never uniformly full)
-    ctx = jnp.asarray(
-        np.linspace(L // 2, L, B, dtype=np.int32)
-    )
-    q = jnp.asarray(r.normal(size=(B, H, 1, D)).astype(np.float32))
+    ctx = np.linspace(L // 2, L, B, dtype=np.int32)
 
-    fn = jax.jit(
-        lambda q_, k_, v_, t_, c_: paged_decode_attention_pallas(
-            q_, k_, v_, t_, c_,
-            pages_per_block=spec.decode_pages_per_block,
-            block_h=spec.decode_block_h,
-            interpret=on_cpu,
+    if spec_k is not None:
+        S = int(spec_k) + 1
+        # verify-shaped batch: S consecutive query positions per slot
+        # ending at the slot's context frontier (the draft window)
+        positions = jnp.asarray(
+            np.stack([np.arange(c - S, c, dtype=np.int32) for c in ctx])
         )
-    )
+        q = jnp.asarray(r.normal(size=(B, H, S, D)).astype(np.float32))
+        fn = jax.jit(
+            lambda q_, k_, v_, t_, p_: paged_verify_attention_pallas(
+                q_, k_, v_, t_, p_,
+                pages_per_block=spec.verify_pages_per_block,
+                block_h=spec.verify_block_h,
+                interpret=on_cpu,
+            )
+        )
+        args5 = (q, k_pages, v_pages, tables, positions)
+        per_dispatch = B * S  # candidate positions scored per dispatch
+    else:
+        q = jnp.asarray(r.normal(size=(B, H, 1, D)).astype(np.float32))
+        fn = jax.jit(
+            lambda q_, k_, v_, t_, c_: paged_decode_attention_pallas(
+                q_, k_, v_, t_, c_,
+                pages_per_block=spec.decode_pages_per_block,
+                block_h=spec.decode_block_h,
+                interpret=on_cpu,
+            )
+        )
+        args5 = (q, k_pages, v_pages, tables, jnp.asarray(ctx))
+        per_dispatch = B  # one decode dispatch = one fresh token per slot
+
     for _ in range(max(warmup, 1)):
-        jax.block_until_ready(fn(q, k_pages, v_pages, tables, ctx))
+        jax.block_until_ready(fn(*args5))
     t0 = time.perf_counter()
     for _ in range(steps):
-        out = fn(q, k_pages, v_pages, tables, ctx)
+        out = fn(*args5)
     jax.block_until_ready(out)
     dt = max(time.perf_counter() - t0, 1e-9)
     return {
-        # one decode dispatch = one fresh token per slot
-        "value": round(B * steps / dt, 1),
+        "value": round(per_dispatch * steps / dt, 1),
         "unit": "tokens/sec",
         "mfu": None,
         "goodput_fraction": None,
@@ -444,6 +472,15 @@ def main() -> int:
     ap.add_argument("--decode-block-hs", default=None,
                     help="decode_block_h candidates "
                     "(workload=serve_decode; default 1,2, smoke 1,2)")
+    ap.add_argument("--spec-k", type=int, default=None,
+                    help="speculative draft length k (workload="
+                    "serve_decode; ISSUE 17): sweep the k-token VERIFY "
+                    "kernel's verify_pages_per_block / verify_block_h "
+                    "instead of the single-token decode knobs — S=k+1 "
+                    "query rows per sequence, scored as candidate "
+                    "positions per second.  The winner persists under a "
+                    "_spec_k<k>-suffixed metric (a verify-kernel winner "
+                    "is never the decode-kernel winner)")
     ap.add_argument("--seq-len", type=int, default=None,
                     help="sequence length for workload=flash / cached "
                     "context length for workload=serve_decode")
@@ -501,10 +538,27 @@ def main() -> int:
             args.decode_pages or ("1,2" if smoke else "1,2,4,8")
         )
         heads = _parse_int_list(args.decode_block_hs or "1,2")
-        space = {"decode_pages_per_block": pages, "decode_block_h": heads}
-        base = TrialSpec(
-            decode_pages_per_block=pages[0], decode_block_h=heads[0]
-        )
+        if args.spec_k is not None:
+            # ISSUE 17: the speculative variant sweeps the verify
+            # kernel's knobs under its own metric suffix
+            metric = (
+                SERVE_DECODE_METRIC + f"_spec_k{args.spec_k}"
+                + ("_smoke" if smoke else "")
+            )
+            space = {
+                "verify_pages_per_block": pages,
+                "verify_block_h": heads,
+            }
+            base = TrialSpec(
+                verify_pages_per_block=pages[0], verify_block_h=heads[0]
+            )
+        else:
+            space = {
+                "decode_pages_per_block": pages, "decode_block_h": heads
+            }
+            base = TrialSpec(
+                decode_pages_per_block=pages[0], decode_block_h=heads[0]
+            )
     else:
         # baselines carry the workload defaults EXPLICITLY (batch 8/256,
         # seg 2/10 — what the worker would fall back to anyway) so the
@@ -550,6 +604,9 @@ def main() -> int:
         ),
         "seq_len": args.seq_len
         or (128 if smoke else (2048 if serve_decode else 4096)),
+        # speculative verify-kernel variant (ISSUE 17): k drafts -> the
+        # trial measures S=k+1 query rows through the verify kernel
+        "spec_k": args.spec_k if serve_decode else None,
         # dp for EVERY trial of a comm sweep (baseline included), so the
         # comm_dtype knob is measured against a dp baseline instead of
         # confounding the wire format with the dp/no-dp switch

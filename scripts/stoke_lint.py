@@ -113,12 +113,23 @@ cfg = ServeConfig(max_seqs=2, kv_block_size=8, max_seq_len=64,
 eng = ServingEngine(gpt, variables["params"], cfg)
 eng.submit(np.array([5, 6, 7], np.int32))
 eng.run()
+# speculative + chunked engine (ISSUE 17): drives the serve_verify and
+# serve_prefill_chunk_packed programs through the auditor too (s keeps
+# the default engine, so the non-speculative serve_prefill/serve_decode
+# programs stay covered)
+spec_cfg = ServeConfig(max_seqs=2, kv_block_size=8, max_seq_len=64,
+                       max_new_tokens=4, prefill_pad_multiple=16,
+                       prefill_chunk_tokens=16, sampling=True,
+                       speculative_k=3)
+spec_eng = ServingEngine(gpt, variables["params"], spec_cfg)
+spec_eng.submit(np.array([5, 9, 3] * 7, np.int32))  # 21 tokens -> 2 chunks
+spec_eng.run()
 
 findings = []
 programs = []
-for st in (s, s2):
+for st, serve_eng in ((s, eng), (s2, spec_eng)):
     before = st.dispatch_count
-    rep = st.audit(serve=eng if st is s else None)
+    rep = st.audit(serve=serve_eng)
     assert st.dispatch_count == before, "audit dispatched a program"
     findings += [f.to_dict() for f in rep.findings]
     programs += rep.programs

@@ -25,8 +25,12 @@ asserted in the traced timeline; ``--serve-only`` runs just that leg —
 the ``make serve-smoke`` entry); since ISSUE 16, one SLO-tagged request
 (serve/slo_* JSONL fields, attainment in the summary block, and the
 span-walked violation attribution whose buckets sum to the measured
-end-to-end latency).  Prints the step record and a one-line verdict;
-exit 0 only when everything round-trips.
+end-to-end latency); since ISSUE 17, the serve cycle runs speculative
+(``speculative_k=3``) with one repetitive-prompt request the
+prompt-lookup drafter accelerates — accept-rate > 0 asserted on the
+serve/spec_* counters, and the greedy streams asserted BIT-IDENTICAL to
+a non-speculative reference engine.  Prints the step record and a
+one-line verdict; exit 0 only when everything round-trips.
 """
 
 from __future__ import annotations
@@ -55,8 +59,14 @@ def run_serve_cycle(sv_dir: str) -> dict:
     pool after the drain, the per-request span timelines — including the
     ``serve/prefill_chunk`` chunk spans — asserted in the exported
     trace, and the SLO request's span-walked attribution summing to its
-    end-to-end latency.  Callable standalone (``--serve-only``, the
-    ``make serve-smoke`` leg) or as part of the full smoke."""
+    end-to-end latency.  Since ISSUE 17 the engine is speculative
+    (``speculative_k=3``): a repetitive-prompt request exercises the
+    prompt-lookup drafter + k-token verify program (accept-rate > 0 on
+    the serve/spec_* counters), and every greedy stream is asserted
+    bit-identical to a non-speculative reference engine — the
+    speculative default-correctness contract.  Callable standalone
+    (``--serve-only``, the ``make serve-smoke`` leg) or as part of the
+    full smoke."""
     import numpy as np
     import optax
 
@@ -70,7 +80,7 @@ def run_serve_cycle(sv_dir: str) -> dict:
         TraceConfig,
     )
     from stoke_tpu.models.gpt import GPT
-    from stoke_tpu.serving import RequestSLO, SamplingParams
+    from stoke_tpu.serving import RequestSLO, SamplingParams, ServingEngine
     from stoke_tpu.telemetry import read_step_events
     from stoke_tpu.utils import init_module
 
@@ -103,6 +113,10 @@ def run_serve_cycle(sv_dir: str) -> dict:
                 # ISSUE 13: chunked prefill + sampling-aware programs
                 # (the two short requests stay greedy — temperature 0)
                 prefill_chunk_tokens=16, sampling=True,
+                # ISSUE 17: self-drafting speculative decode — every
+                # decode iteration is a k-token verify dispatch; greedy
+                # streams stay bit-identical (asserted below)
+                speculative_k=3,
             ),
             # traced serve requests (ISSUE 10/13): the per-request
             # admission -> [chunks] -> prefill -> decode timelines are
@@ -132,7 +146,40 @@ def run_serve_cycle(sv_dir: str) -> dict:
         slo=RequestSLO(priority="interactive",
                        ttft_target_s=60.0, tpot_target_s=60.0),
     )
+    # ISSUE 17: one repetitive-prompt greedy request — the workload
+    # prompt-lookup drafting exists for (the tiled trigram repeats, so
+    # the drafter proposes the continuation and the verify program
+    # accepts it; accept-rate > 0 asserted below)
+    spec_prompt = np.asarray([5, 9, 3] * 4, np.int32)
+    spec_rid = sv_eng.submit(spec_prompt, 8)
     sv_eng.run()
+    # greedy-identity reference (ISSUE 17): the same greedy prompts
+    # through a NON-speculative engine (same model / int8 weights — the
+    # quantizer is seed-deterministic) must yield bit-identical streams;
+    # exact-match verification makes speculation a pure dispatch-count
+    # optimization
+    ref_eng = ServingEngine(
+        sv_model, sv_vars["params"],
+        ServeConfig(
+            max_seqs=2, kv_block_size=8, max_seq_len=64,
+            max_new_tokens=4, prefill_pad_multiple=16,
+            quant="int8", quant_min_size=256,
+            prefill_chunk_tokens=16, sampling=True,
+        ),
+    )
+    ref_r = np.random.default_rng(0)
+    ref_prompts = [
+        ref_r.integers(1, 211, size=7).astype(np.int32) for _ in range(2)
+    ]
+    ref_rids = [ref_eng.submit(p, 4) for p in ref_prompts]
+    ref_spec_rid = ref_eng.submit(spec_prompt, 8)
+    ref_eng.run()
+    greedy_identity = all(
+        list(sv_eng.scheduler.finished[a].tokens)
+        == list(ref_eng.scheduler.finished[b].tokens)
+        for a, b in list(zip(sv_rids, ref_rids))
+        + [(spec_rid, ref_spec_rid)]
+    )
     sv.close_telemetry()
     sv_rec = read_step_events(os.path.join(sv_dir, "steps.jsonl"))[-1]
     sv_prom = open(os.path.join(sv_dir, "metrics.prom")).read()
@@ -157,12 +204,15 @@ def run_serve_cycle(sv_dir: str) -> dict:
         + slo_attr.get("prefill_blocked_s", 0.0)
         + slo_attr.get("decode_contention_s", 0.0)
     )
+    spec_drafted = sv_rec.get("serve/spec_draft_tokens") or 0.0
+    spec_accepted = sv_rec.get("serve/spec_accepted_tokens") or 0.0
     ok = (
         all(
             len(sv_eng.scheduler.finished[rid].tokens) == 4
             for rid in sv_rids + [long_rid, slo_rid]
         )
-        and sv_rec.get("serve/completed") == 4.0
+        and len(sv_eng.scheduler.finished[spec_rid].tokens) == 8
+        and sv_rec.get("serve/completed") == 5.0
         and sv_rec.get("serve/ttft_p50_s") is not None
         and sv_rec.get("serve/tpot_p50_s") is not None
         and (sv_rec.get("serve/quant_compression") or 0) >= 3.5
@@ -190,9 +240,24 @@ def run_serve_cycle(sv_dir: str) -> dict:
         and abs(slo_bucket_sum - slo_attr.get("e2e_s", -1.0)) < 1e-9
         and slo_summary.get("by_class", {})
         .get("interactive", {}).get("attained") == 1
+        # ISSUE 17: speculative wire evidence — drafts scored AND
+        # accepted (accept-rate > 0), acceptance never exceeding the
+        # drafted count, and the greedy streams bit-identical to the
+        # non-speculative reference engine
+        and spec_drafted > 0
+        and 0 < spec_accepted <= spec_drafted
+        and greedy_identity
     )
     return {
         "ok": ok,
+        "spec_drafted": spec_drafted,
+        "spec_accepted": spec_accepted,
+        "spec_accept_rate": (
+            spec_accepted / spec_drafted if spec_drafted else 0.0
+        ),
+        "greedy_identity": greedy_identity,
+        "spec_rid": spec_rid,
+        "spec_tokens": list(sv_eng.scheduler.finished[spec_rid].tokens),
         "record": sv_rec,
         "engine": sv_eng,
         "prom": sv_prom,
@@ -708,8 +773,10 @@ def main() -> int:
 
 def serve_only() -> int:
     """The ``make serve-smoke`` leg: just the traced serve cycle — one
-    chunked-prefill + top-p request (plus two greedy ones) end-to-end,
-    chunk spans asserted in the exported timeline."""
+    chunked-prefill + top-p request (plus two greedy ones and the
+    ISSUE 17 speculative repetitive-prompt request) end-to-end, chunk
+    spans asserted in the exported timeline and the speculative
+    accept-rate / greedy-identity contract asserted on the counters."""
     out_dir = os.environ.get(
         "STOKE_TELEMETRY_SMOKE_DIR",
         tempfile.mkdtemp(prefix="stoke-serve-smoke-"),
@@ -731,6 +798,10 @@ def serve_only() -> int:
             for k in ("queue_wait_s", "prefill_blocked_s",
                       "decode_contention_s", "e2e_s", "span_coverage")
         },
+        "spec_accept_rate": res["spec_accept_rate"],
+        "spec_drafted": res["spec_drafted"],
+        "spec_accepted": res["spec_accepted"],
+        "spec_greedy_identity": res["greedy_identity"],
         "trace_requests": sorted(res["spans_by_rid"]),
     }))
     return 0 if res["ok"] else 1

@@ -59,6 +59,13 @@ KNOB_KIND: Dict[str, str] = {
     # memory-kind; swept by `scripts/autotune.py --workload serve_decode`
     "decode_pages_per_block": "memory",
     "decode_block_h": "memory",
+    # ISSUE 17 speculative decode: the Pallas k-token verify kernel's
+    # block knobs (same HBM→VMEM streaming loop as the decode kernel,
+    # S=k+1 query rows per sequence) — memory-kind for the same reason;
+    # swept by `scripts/autotune.py --workload serve_decode` when the
+    # sweep runs its speculative variant
+    "verify_pages_per_block": "memory",
+    "verify_block_h": "memory",
 }
 
 #: bound classification -> knob kinds worth sweeping, in priority order.
@@ -103,6 +110,8 @@ class TrialSpec:
     comm_dtype: Optional[str] = None
     decode_pages_per_block: Optional[int] = None
     decode_block_h: Optional[int] = None
+    verify_pages_per_block: Optional[int] = None
+    verify_block_h: Optional[int] = None
 
     def config_key(self) -> str:
         """Canonical, process-stable identity of this configuration (the

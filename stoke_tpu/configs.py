@@ -1393,6 +1393,30 @@ class ServeConfig:
             never SLO-tracked, and an engine that sees none emits zero
             ``serve/slo_*`` JSONL fields with program HLO bit-identical
             to pre-ISSUE-16 (the tracker is purely host-side).
+        speculative_k: speculative decoding (ISSUE 17) — draft up to this
+            many tokens per request per decode iteration from the
+            host-side prompt-lookup drafter and score them all in ONE
+            verify dispatch (accepted run + one correction/bonus token
+            emitted; >1 token per dispatch when drafts hit).  Requires
+            ``sampling=True`` (the verify program rides the key-threaded
+            sampling machinery; ``temperature=0.0`` keeps exact greedy
+            streams — emitted streams bit-match the non-speculative
+            engine in every mode).  ``None`` (default) = off, programs
+            bit-identical to pre-ISSUE-17.  With chunked prefill, must
+            satisfy ``speculative_k + 1 <= prefill_chunk_tokens`` (the
+            verify query width stays within the chunk budget that bounds
+            per-iteration work).
+        speculative_ngram_max / speculative_ngram_min: the drafter's
+            tail n-gram length bounds (longest tried first; see
+            ``serving/speculative.py``).  Only read when
+            ``speculative_k`` is set — non-default values without it are
+            a status error, never silently ignored.
+        verify_pages_per_block / verify_block_h: the pallas verify
+            kernel's block knobs (autotune catalog entries
+            ``verify_pages_per_block`` / ``verify_block_h`` under the
+            ``serve_decode`` sweep).  Only read when ``speculative_k``
+            is set AND ``decode_kernel="pallas"``; setting them outside
+            that is a status error.
     """
 
     max_seqs: int = 8
@@ -1420,6 +1444,11 @@ class ServeConfig:
     log_every_n_steps: int = 8
     slo_ttft_target_s: Optional[float] = None
     slo_tpot_target_s: Optional[float] = None
+    speculative_k: Optional[int] = None
+    speculative_ngram_max: int = 3
+    speculative_ngram_min: int = 1
+    verify_pages_per_block: Optional[int] = None
+    verify_block_h: Optional[int] = None
 
 
 @dataclass

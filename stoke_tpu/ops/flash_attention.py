@@ -796,6 +796,209 @@ def paged_prefill_chunk_attention(q, k_pages, v_pages, block_tables,
     return out.astype(q.dtype)
 
 
+#: default KV pages streamed per step by the speculative verify kernel
+#: (ISSUE 17) — its own autotune catalog knob (``verify_pages_per_block``)
+#: because the verify grid amortizes each fetched page over k+1 query rows,
+#: shifting the DMA/compute balance away from the decode kernel's optimum
+DEFAULT_VERIFY_PAGES_PER_BLOCK = 8
+#: default heads per verify grid cell (catalog knob ``verify_block_h``)
+DEFAULT_VERIFY_BLOCK_H = 1
+
+
+def paged_verify_attention(q, k_pages, v_pages, block_tables, positions):
+    """Speculative-verify attention over a paged KV-cache (ISSUE 17).
+
+    The verify program scores a request's next token plus its k draft
+    continuations in ONE dispatch: S = k+1 query rows per request, each
+    attending the cache window at its own global position.  Semantically
+    this IS :func:`paged_prefill_chunk_attention` — multi-token queries
+    over the paged prefix with the positional causal predicate — applied
+    at decode time, which is exactly why the chunk program shape pins the
+    verify semantics (ROADMAP item 2).  Kept as its own named entry point
+    so the Pallas fast path (:func:`paged_verify_attention_pallas`) has
+    pinned reference semantics independent of future chunk changes.
+
+    Args:
+        q: ``[B, H, S, D]`` verify queries (S = speculative_k + 1).
+        k_pages / v_pages: ``[NB, BS, H, D]`` block pool for one layer.
+        block_tables: ``[B, MAX_BLOCKS] int32`` per-request block ids.
+        positions: ``[B, S] int32`` global positions of the verify
+            queries; padding rows (requests with short drafts) carry
+            clamped positions and their outputs are discarded.
+
+    Returns ``[B, H, S, D]`` attention outputs in the query dtype.
+    """
+    return paged_prefill_chunk_attention(
+        q, k_pages, v_pages, block_tables, positions
+    )
+
+
+def _paged_verify_kernel(tables_ref, pos_ref, q_ref, k_hbm, v_hbm, o_ref,
+                         k_vmem, v_vmem, sem_k, sem_v, *, block_size,
+                         pages_per_block, n_steps, block_h, n_q, scale):
+    """Streaming verify-attention body: the :func:`_paged_decode_kernel`
+    schedule (double-buffered HBM→VMEM page DMA, fp32 online softmax)
+    generalized to ``n_q`` query rows per request.  Each fetched page is
+    folded into ALL n_q rows' accumulators — the per-byte compute that
+    makes speculative decode pay: one table walk now scores k+1
+    candidate positions instead of one."""
+    b = pl.program_id(0)
+    hg = pl.program_id(1)
+    group = pages_per_block * block_size
+
+    def copies(j, slot):
+        out = []
+        for p in range(pages_per_block):
+            blk = tables_ref[b, j * pages_per_block + p]
+            for src, dst, sem in (
+                (k_hbm, k_vmem, sem_k), (v_hbm, v_vmem, sem_v)
+            ):
+                out.append(
+                    pltpu.make_async_copy(
+                        src.at[blk, :, pl.ds(hg * block_h, block_h), :],
+                        dst.at[slot, pl.ds(p * block_size, block_size)],
+                        sem.at[slot],
+                    )
+                )
+        return out
+
+    D = q_ref.shape[-1]
+    q = q_ref[0].astype(jnp.float32) * scale  # [block_h, n_q, D]
+    qpos = jnp.stack(
+        [pos_ref[b, s] for s in range(n_q)]
+    ).reshape(n_q, 1)  # [n_q, 1] global positions out of SMEM
+    m = [jnp.full((n_q, 1), _NEG_INF, jnp.float32) for _ in range(block_h)]
+    l = [jnp.zeros((n_q, 1), jnp.float32) for _ in range(block_h)]
+    acc = [jnp.zeros((n_q, D), jnp.float32) for _ in range(block_h)]
+
+    for c in copies(0, 0):
+        c.start()
+    for j in range(n_steps):
+        slot = j % 2
+        if j + 1 < n_steps:
+            for c in copies(j + 1, (j + 1) % 2):
+                c.start()
+        for c in copies(j, slot):
+            c.wait()
+        kb = k_vmem[slot].astype(jnp.float32)  # [group, block_h, D]
+        vb = v_vmem[slot].astype(jnp.float32)
+        pos = j * group + jax.lax.broadcasted_iota(
+            jnp.int32, (1, group), 1
+        )
+        valid = pos <= qpos  # [n_q, group] positional causality
+        for hh in range(block_h):
+            s = jax.lax.dot_general(
+                q[hh], kb[:, hh, :], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [n_q, group]
+            s = jnp.where(valid, s, _NEG_INF)
+            m_new = jnp.maximum(m[hh], jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            p = jnp.where(s > _NEG_INF * 0.5, p, 0.0)
+            corr = jnp.exp(m[hh] - m_new)
+            l[hh] = l[hh] * corr + jnp.sum(p, axis=-1, keepdims=True)
+            m[hh] = m_new
+            pv = jax.lax.dot_general(
+                p, vb[:, hh, :], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc[hh] = acc[hh] * corr + pv
+
+    for hh in range(block_h):
+        safe_l = jnp.where(l[hh] > 0, l[hh], 1.0)
+        o_ref[0, hh] = (acc[hh] / safe_l).astype(o_ref.dtype)
+
+
+def paged_verify_attention_pallas(
+    q, k_pages, v_pages, block_tables, positions, *,
+    pages_per_block: Optional[int] = None, block_h: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """Pallas verify attention: the k-token speculative-decode kernel
+    (ISSUE 17), with :func:`paged_verify_attention` as its pinned
+    reference semantics.
+
+    Identical memory schedule to :func:`paged_decode_attention_pallas`
+    — grid ``(batch, heads/block_h)``, block table in SMEM, page pools
+    in HBM (``pltpu.ANY``), double-buffered VMEM landing zone — but each
+    grid cell scores S = k+1 query rows against every streamed page, so
+    the per-dispatch HBM traffic (the decode bottleneck) is amortized
+    over up to k+1 emitted tokens.  Masking is positional per query row
+    (``w_pos <= positions[b, s]``), matching the chunk-attention
+    predicate rather than decode's ``< context_lens``.
+
+    Args:
+        q: ``[B, H, S, D]`` verify queries.
+        k_pages / v_pages: ``[NB, BS, H, D]`` pools for one layer.
+        block_tables: ``[B, MAX_BLOCKS] int32`` per-request block ids
+            (unused entries at the reserved scratch block 0).
+        positions: ``[B, S] int32`` per-query global positions.
+        pages_per_block / block_h: catalog knobs
+            ``verify_pages_per_block`` / ``verify_block_h`` (clamped to
+            divisors like the decode kernel's).
+        interpret: pallas interpreter toggle (``None`` = auto off-TPU).
+    """
+    B, H, S, D = q.shape
+    if k_pages.shape != v_pages.shape or k_pages.ndim != 4:
+        raise ValueError(
+            f"k_pages/v_pages must be identical [NB, BS, H, D] pools, got "
+            f"{k_pages.shape}/{v_pages.shape}"
+        )
+    if k_pages.shape[2] != H or k_pages.shape[3] != D:
+        raise ValueError(
+            f"page pool heads/dim {k_pages.shape[2:]} do not match the "
+            f"query's {(H, D)}"
+        )
+    if block_tables.ndim != 2 or block_tables.shape[0] != B:
+        raise ValueError(
+            f"block_tables must be [B={B}, MAX_BLOCKS], got "
+            f"{block_tables.shape}"
+        )
+    if positions.shape != (B, S):
+        raise ValueError(
+            f"positions must be [B={B}, S={S}], got {positions.shape}"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    BS = int(k_pages.shape[1])
+    MB = int(block_tables.shape[1])
+    ppb = _pick_divisor(pages_per_block, MB, DEFAULT_VERIFY_PAGES_PER_BLOCK)
+    bh = _pick_divisor(block_h, H, DEFAULT_VERIFY_BLOCK_H)
+    n_steps = MB // ppb
+    kernel = functools.partial(
+        _paged_verify_kernel,
+        block_size=BS, pages_per_block=ppb, n_steps=n_steps, block_h=bh,
+        n_q=S, scale=1.0 / (D**0.5),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, H // bh),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # block tables [B, MB]
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # positions [B, S]
+            pl.BlockSpec((1, bh, S, D), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),   # K pool stays in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),   # V pool stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, bh, S, D), lambda b, h: (b, h, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, ppb * BS, bh, D), k_pages.dtype),
+            pltpu.VMEM((2, ppb * BS, bh, D), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(
+        block_tables.astype(jnp.int32),
+        positions.astype(jnp.int32),
+        q,
+        k_pages,
+        v_pages,
+    )
+    return out
+
+
 def make_flash_attention(
     causal: bool = False, block_q: Optional[int] = None,
     block_k: Optional[int] = None, interpret: Optional[bool] = None,

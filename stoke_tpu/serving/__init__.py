@@ -9,7 +9,10 @@ The inference vertical behind ``Stoke.serve()``:
 - :mod:`~stoke_tpu.serving.quant` — int8/bf16 weight store reusing the
   PR-2 stochastic-rounding quantizer, matmul-side dequant;
 - :mod:`~stoke_tpu.serving.sampling` — temperature / top-k / top-p
-  sampling with per-request seeded key streams (ISSUE 13);
+  sampling with per-request seeded key streams (ISSUE 13), plus the
+  speculative accept/reject layer (ISSUE 17);
+- :mod:`~stoke_tpu.serving.speculative` — the host-side n-gram /
+  prompt-lookup drafter feeding the k-token verify program (ISSUE 17);
 - :mod:`~stoke_tpu.serving.telemetry` — TTFT/TPOT histograms + p50/p99
   gauges, capacity gauges, queue/prefill/decode goodput buckets;
 - :mod:`~stoke_tpu.serving.slo` — per-request deadlines + priority
@@ -37,10 +40,12 @@ from stoke_tpu.serving.quant import (
 )
 from stoke_tpu.serving.sampling import (
     SamplingParams,
+    accept_drafts,
     sample_tokens,
     validate_sampling_params,
 )
 from stoke_tpu.serving.scheduler import Request, Scheduler
+from stoke_tpu.serving.speculative import propose_draft
 from stoke_tpu.serving.slo import (
     RequestSLO,
     SLOTracker,
@@ -50,6 +55,8 @@ from stoke_tpu.serving.telemetry import ServeMetrics
 
 __all__ = [
     "SamplingParams",
+    "accept_drafts",
+    "propose_draft",
     "sample_tokens",
     "validate_sampling_params",
     "RequestSLO",

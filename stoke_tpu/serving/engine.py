@@ -38,6 +38,22 @@ decode attention through the streaming Pallas kernel
 ``prefill_chunk_tokens`` bounds per-iteration prefill work so one long
 prompt cannot stall the in-flight decode batch (chunks interleave with
 decode steps; ``serve/prefill_chunk`` spans on the request timeline).
+
+Speculative decoding (ISSUE 17, ``ServeConfig.speculative_k``): decode at
+low batch is dispatch-bound — one query token per request per dispatch —
+so the engine grows a **verify** program: the host-side prompt-lookup
+drafter (``serving/speculative.py``) proposes up to k tokens per request
+from history it already owns, the verify dispatch scores all k+1
+positions in one forward (chunk-attention semantics over the paged
+cache), the accept rule keeps the leading exact-match run, and rejected
+positions' K/V roll back out of the pool before the dispatch returns.
+Exact-match acceptance makes emitted streams BIT-identical to the
+non-speculative engine in every sampling mode (each emitted token is the
+true model draw with the correct sequential subkey — the draft only
+decides how many draws one dispatch keeps).  The same multi-token-query
+shape packs all prefilling slots' chunks into one dispatch
+(``serve_prefill_chunk_packed``).  ``speculative_k=None`` engines compile
+the PR-13 programs verbatim.
 """
 
 from __future__ import annotations
@@ -64,8 +80,11 @@ from stoke_tpu.serving.quant import (
 )
 from stoke_tpu.serving.sampling import (
     SamplingParams,
+    accept_drafts,
     initial_key_data,
     sample_tokens,
+    select_key_data,
+    speculative_sample_tokens,
     split_key_data,
     validate_sampling_params,
 )
@@ -157,6 +176,13 @@ class ServingEngine:
                 f"{cfg.prefill_pad_multiple} (the bucket discipline that "
                 f"bounds compiled-program count; same rule the status "
                 f"layer enforces)"
+            )
+        if cfg.speculative_k is not None and not cfg.sampling:
+            raise ValueError(
+                "ServeConfig.speculative_k needs sampling=True — the "
+                "verify program rides the key-threaded sampling programs "
+                "(temperature=0.0 keeps exact greedy streams); set "
+                "sampling=True or drop speculative_k"
             )
         if _round_up(cfg.max_seq_len, cfg.prefill_pad_multiple) > model.max_len:
             raise ValueError(
@@ -331,6 +357,29 @@ class ServingEngine:
             if cfg.prefill_chunk_tokens is not None
             else None
         )
+        # speculative decoding (ISSUE 17): the verify program replaces the
+        # per-token decode program, and chunk packing replaces the
+        # one-chunk-per-iteration schedule with the same multi-token-query
+        # program shape.  Both are construction-time choices gated on
+        # speculative_k — a speculative_k=None engine compiles the PR-13
+        # programs verbatim (HLO bit-identical, the default-OFF contract
+        # audit_specs lowering asserts).
+        self._speculative_k = cfg.speculative_k
+        self._verify_jit = (
+            jax.jit(self._verify_fn, donate_argnums=donate)
+            if cfg.speculative_k is not None
+            else None
+        )
+        self._packed_chunk_jit = (
+            jax.jit(self._packed_chunk_fn, donate_argnums=donate)
+            if (
+                cfg.speculative_k is not None
+                and cfg.prefill_chunk_tokens is not None
+            )
+            else None
+        )
+        if cfg.speculative_k is not None:
+            self.metrics.enable_speculative()
 
         # program-audit ledger (ISSUE 15): one abstract spec per
         # (program, shape signature), recorded at the dispatch funnel so
@@ -371,6 +420,8 @@ class ServingEngine:
             decode_pages_per_block=self.cfg.decode_pages_per_block,
             decode_block_h=self.cfg.decode_block_h,
             decode_interpret=self._decode_interpret,
+            verify_pages_per_block=self.cfg.verify_pages_per_block,
+            verify_block_h=self.cfg.verify_block_h,
         )
 
     def _prefill_fn(self, qparams, k_pages, v_pages, tokens, block_row,
@@ -469,6 +520,60 @@ class ServingEngine:
         key_out, sub = split_key_data(key_data)
         tok = sample_tokens(row, sub, temp, top_k, top_p)
         return tok, key_out, row, hook.k_pages, hook.v_pages
+
+    # --- speculative programs (ISSUE 17): fixed-shape k-token verify and
+    # packed chunked prefill — both the multi-token-query shape the chunk
+    # program pinned, compiled only when ``speculative_k`` is set. ---
+
+    def _verify_fn(self, qparams, k_pages, v_pages, tokens, positions,
+                   block_tables, lengths, draft_lens, key_data, temps,
+                   top_ks, top_ps):
+        """ONE speculative verify step (ISSUE 17): tokens ``[B, S]`` =
+        each slot's pending token + up to k drafts at GLOBAL positions
+        ``[B, S]``; scores all S positions in one forward, draws the S
+        sequential target tokens from each slot's key stream, accepts
+        the leading exact-match run, rolls rejected positions' K/V back
+        out of the cache (scratch-steered restore — rejected drafts
+        never dirty the pool across dispatches), and rewinds each slot's
+        key state to one split per EMITTED token.  Returns ``(targets
+        [B, S], n_emit [B], key data [B, ...], pre-sampling logits
+        [B, S, V], updated pages)``."""
+        params = dequantize_params(qparams)
+        hook = self._make_hook(
+            k_pages, v_pages, block_tables, positions, "verify", lengths
+        )
+        logits = self._apply(params, tokens, positions, hook, decode=False)
+        targets, key_stack = speculative_sample_tokens(
+            logits, key_data, temps, top_ks, top_ps
+        )
+        n_emit = accept_drafts(tokens[:, 1:], draft_lens, targets)
+        hook.rollback(n_emit)
+        key_out = select_key_data(key_stack, n_emit)
+        return targets, n_emit, key_out, logits, hook.k_pages, hook.v_pages
+
+    def _packed_chunk_fn(self, qparams, k_pages, v_pages, tokens, positions,
+                         block_tables, lengths, logit_idx, key_data, temps,
+                         top_ks, top_ps):
+        """Packed chunked prefill (ISSUE 17): every prefilling slot's next
+        chunk rides ONE dispatch — tokens ``[B, C]`` at global positions
+        ``[B, C]`` against the full slot batch's tables (idle rows
+        scratch-steered, outputs discarded), the same multi-token-query
+        shape as :meth:`_verify_fn`.  Samples every row at its own
+        ``logit_idx`` (only final-chunk rows' draws are consumed; their
+        callers also take the key writeback, preserving one split per
+        emitted token).  Returns ``(tokens [B], advanced key data,
+        pre-sampling logit rows [B, V], updated pages)``."""
+        params = dequantize_params(qparams)
+        hook = self._make_hook(
+            k_pages, v_pages, block_tables, positions, "chunk", lengths
+        )
+        logits = self._apply(params, tokens, positions, hook, decode=False)
+        rows = jnp.take_along_axis(
+            logits, logit_idx[:, None, None], axis=1
+        )[:, 0]  # [B, V]
+        key_out, sub = split_key_data(key_data)
+        tok = sample_tokens(rows, sub, temps, top_ks, top_ps)
+        return tok, key_out, rows, hook.k_pages, hook.v_pages
 
     # ------------------------------------------------------------------ #
     # program-signature dispatch (PR-6 AOT ledger registration)
@@ -696,6 +801,159 @@ class ServingEngine:
                 )
             self._emit_first_token(slot, req, tok_host, now)
 
+    def _run_packed_chunks(self, tokens, positions, tables, lengths,
+                           logit_idx, rows) -> None:
+        """One PACKED chunked-prefill step (ISSUE 17): every prefilling
+        slot's next chunk rides one fixed-shape ``[B, C]`` dispatch.
+        Final-chunk rows produce their TTFT tokens and take the key
+        writeback; every serviced row advances its prefill cursor."""
+        sched, m = self.scheduler, self.metrics
+        B = self.cfg.max_seqs
+        temps = np.zeros(B, np.float32)
+        ks = np.zeros(B, np.int32)
+        ps = np.ones(B, np.float32)
+        for i, req, _is_final in rows:
+            temps[i], ks[i], ps[i] = req.params.as_arrays()
+        t0 = time.perf_counter()
+        with trace_span(
+            "serve/prefill_chunk_packed", track="serve",
+            attrs={"packed": len(rows), "chunk": int(tokens.shape[1])},
+        ):
+            args = (
+                self.qparams,
+                self.cache.k_pages,
+                self.cache.v_pages,
+                jnp.asarray(tokens),
+                jnp.asarray(positions),
+                jnp.asarray(tables),
+                jnp.asarray(lengths),
+                jnp.asarray(logit_idx),
+                jnp.asarray(self._key_data),
+                jnp.asarray(temps),
+                jnp.asarray(ks),
+                jnp.asarray(ps),
+            )
+            tok, key_out, logit_rows, k_pages, v_pages = self._dispatch(
+                "serve_prefill_chunk_packed", self._packed_chunk_jit, args
+            )
+            self.cache.k_pages, self.cache.v_pages = k_pages, v_pages
+            # sync for the same reason the single-chunk path does: the
+            # chunk compute must be charged to the prefill bucket, not
+            # the next dispatch's fetch
+            tok_host = np.asarray(tok)
+        now = time.perf_counter()
+        m.prefill_chunks.inc()  # dispatches, not serviced rows
+        m.prefill_s.inc(now - t0)
+        kd = np.asarray(key_out)
+        larr = np.asarray(logit_rows) if self.capture_logits else None
+        for i, req, is_final in rows:
+            if tracing_active():
+                # per-request slice of the shared packed interval — the
+                # SLO attribution walk keys on the serve/prefill_chunk
+                # span name; count_self=False since the packed span above
+                # owns the wall once
+                trace_add(
+                    "serve/prefill_chunk", t0, now, track="serve",
+                    request_id=req.rid, count_self=False,
+                )
+            sched.note_chunk(i)
+            if is_final:
+                self._key_data[i] = kd[i]
+                if larr is not None:
+                    self.captured_logits.setdefault(req.rid, []).append(
+                        larr[i].copy()
+                    )
+                self._emit_first_token(i, req, int(tok_host[i]), now)
+
+    def _step_verify(self) -> None:
+        """One speculative decode step (ISSUE 17): draft host-side,
+        verify all draft positions in one dispatch, commit the accepted
+        run + the correction/bonus token.  Replaces the per-token decode
+        dispatch — ``decode_steps`` still counts dispatches, so
+        tokens_out / decode_steps IS accepted-tokens-per-dispatch."""
+        sched, m = self.scheduler, self.metrics
+        k = self._speculative_k
+        decode_rows = [
+            i
+            for i, s in enumerate(sched.slots)
+            if s.request is not None and s.prefill_pos is None
+        ]
+        live_rids = (
+            [sched.slots[i].request.rid for i in decode_rows]
+            if tracing_active()
+            else None
+        )
+        t0 = time.perf_counter()
+        with trace_span("serve/verify_step", track="serve",
+                        attrs={"active": sched.decoding, "k": k}):
+            tokens, positions, tables, lengths, draft_lens = (
+                sched.verify_batch(
+                    k,
+                    ngram_max=self.cfg.speculative_ngram_max,
+                    ngram_min=self.cfg.speculative_ngram_min,
+                )
+            )
+            temps, tks, tps = sched.sampling_batch()
+            args = (
+                self.qparams,
+                self.cache.k_pages,
+                self.cache.v_pages,
+                jnp.asarray(tokens),
+                jnp.asarray(positions),
+                jnp.asarray(tables),
+                jnp.asarray(lengths),
+                jnp.asarray(draft_lens),
+                jnp.asarray(self._key_data),
+                jnp.asarray(temps),
+                jnp.asarray(tks),
+                jnp.asarray(tps),
+            )
+            targets, n_emit, key_out, logits, k_pages, v_pages = (
+                self._dispatch("serve_verify", self._verify_jit, args)
+            )
+            self.cache.k_pages, self.cache.v_pages = k_pages, v_pages
+            targets_host = np.asarray(targets)  # sync: tokens stream out
+            n_emit_host = np.asarray(n_emit)
+            kd = np.asarray(key_out)
+            for i in decode_rows:
+                self._key_data[i] = kd[i]
+            if self.capture_logits:
+                larr = np.asarray(logits)
+                for i in decode_rows:
+                    rid = sched.slots[i].request.rid
+                    # one pre-sampling logits row per EMITTED token, so
+                    # speculative captures align 1:1 with the
+                    # non-speculative engine's per-step captures
+                    for j in range(int(n_emit_host[i])):
+                        self.captured_logits.setdefault(rid, []).append(
+                            larr[i, j].copy()
+                        )
+        now = time.perf_counter()
+        if live_rids:
+            for rid in live_rids:
+                trace_add("serve/decode", t0, now, track="serve",
+                          request_id=rid, count_self=False)
+        m.decode_steps.inc()
+        m.decode_s.inc(now - t0)
+        # greedy-ness per row, read BEFORE commit evicts finished slots
+        greedy_row = {
+            i: sched.slots[i].request.params.is_greedy for i in decode_rows
+        }
+        was_finished = set(sched.finished)
+        committed, accepted = sched.commit_verify(
+            targets_host, n_emit_host, now
+        )
+        m.tokens_out.inc(int(committed.sum()))
+        m.spec_draft_tokens.inc(int(draft_lens.sum()))
+        m.spec_accepted_tokens.inc(accepted)
+        n_sampled = sum(
+            int(committed[i]) for i in decode_rows if not greedy_row[i]
+        )
+        if n_sampled:
+            m.sampled_tokens.inc(n_sampled)
+        for rid in set(sched.finished) - was_finished:
+            self._finish(sched.finished[rid])
+
     def step(self) -> bool:
         """One engine iteration: admit arrivals (short prompts prefill
         whole; long ones enter the chunked-prefill state), run at most ONE
@@ -725,11 +983,18 @@ class ServingEngine:
                 continue  # chunked admission: chunks run below
             self._prefill_one(slot, req, padded, plen)
 
-        nxt = sched.next_chunk()
-        if nxt is not None:
-            self._run_chunk(*nxt)
+        if self._packed_chunk_jit is not None:
+            nxt = sched.next_chunks()
+            if nxt is not None:
+                self._run_packed_chunks(*nxt)
+        else:
+            nxt = sched.next_chunk()
+            if nxt is not None:
+                self._run_chunk(*nxt)
 
-        if sched.decoding > 0:
+        if sched.decoding > 0 and self._verify_jit is not None:
+            self._step_verify()
+        elif sched.decoding > 0:
             # rows in the decode batch (fully-prefilled slots) BEFORE the
             # commit evicts any — each gets a per-request decode-slice
             # span below, and sampling key writebacks target exactly them

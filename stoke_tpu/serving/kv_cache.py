@@ -43,6 +43,8 @@ from stoke_tpu.ops.flash_attention import (
     paged_decode_attention,
     paged_decode_attention_pallas,
     paged_prefill_chunk_attention,
+    paged_verify_attention,
+    paged_verify_attention_pallas,
 )
 
 #: block id every unused block-table entry (and every inactive slot) points
@@ -176,11 +178,16 @@ class PagedAttentionHook:
         positions: ``[B, L] int32`` token positions being written this
             call (prefill: ``arange`` rows; decode: each slot's current
             position, L == 1).
-        mode: ``"prefill"``, ``"chunk"`` (chunked prefill, ISSUE 13), or
-            ``"decode"``.
+        mode: ``"prefill"``, ``"chunk"`` (chunked prefill, ISSUE 13),
+            ``"decode"``, or ``"verify"`` (speculative k-token verify,
+            ISSUE 17 — chunk-style positional writes/attention, plus
+            save-before-write so :meth:`rollback` can restore rejected
+            draft positions exactly).
         lengths: ``[B] int32`` — prefill/chunk: true prompt lengths
             (padding positions write to the scratch block and are
-            masked); decode: context lengths INCLUDING the fresh token.
+            masked); decode: context lengths INCLUDING the fresh token;
+            verify: context + draft length + 1 (the write budget —
+            padding query rows past it steer to scratch).
         attention_impl: prefill kernel, ``"dense"`` or ``"flash"``.
         decode_impl: decode kernel — ``"reference"`` (the jnp
             gathered-block :func:`paged_decode_attention`) or
@@ -191,6 +198,11 @@ class PagedAttentionHook:
             entries).
         decode_interpret: run the pallas kernel through the interpreter
             (``None`` = auto off-TPU — the CPU parity mode).
+        verify_pages_per_block / verify_block_h: the verify kernel's
+            block knobs (``None`` = its defaults; autotune catalog
+            entries ``verify_pages_per_block`` / ``verify_block_h``).
+            ``decode_impl`` selects reference vs pallas for verify too —
+            both kernels share the streaming memory schedule.
     """
 
     def __init__(
@@ -207,8 +219,10 @@ class PagedAttentionHook:
         decode_pages_per_block: Optional[int] = None,
         decode_block_h: Optional[int] = None,
         decode_interpret: Optional[bool] = None,
+        verify_pages_per_block: Optional[int] = None,
+        verify_block_h: Optional[int] = None,
     ):
-        if mode not in ("prefill", "chunk", "decode"):
+        if mode not in ("prefill", "chunk", "decode", "verify"):
             raise ValueError(f"unknown PagedAttentionHook mode {mode!r}")
         if decode_impl not in ("reference", "pallas"):
             raise ValueError(
@@ -226,7 +240,12 @@ class PagedAttentionHook:
         self.decode_pages_per_block = decode_pages_per_block
         self.decode_block_h = decode_block_h
         self.decode_interpret = decode_interpret
+        self.verify_pages_per_block = verify_pages_per_block
+        self.verify_block_h = verify_block_h
         self.block_size = int(k_pages.shape[2])
+        # verify mode: per-layer (blocks, offs, old_k, old_v) snapshots
+        # taken before each write, consumed by rollback()
+        self._saved: List[tuple] = []
 
     # ------------------------------ writes ----------------------------- #
 
@@ -243,10 +262,11 @@ class PagedAttentionHook:
         pos = self.positions.reshape(-1)  # [B*L]
         slot = jnp.repeat(jnp.arange(B, dtype=jnp.int32), L)
         blk_idx = pos // self.block_size
-        if self.mode in ("prefill", "chunk"):
+        if self.mode in ("prefill", "chunk", "verify"):
             # chunk rows past the prompt end (the last chunk's padding)
             # carry clamped positions >= the prompt length, so the same
-            # predicate steers them to scratch
+            # predicate steers them to scratch; verify's lengths bound
+            # the real write window (context + draft + 1) the same way
             valid = (
                 self.positions
                 < self.lengths[:, None].astype(self.positions.dtype)
@@ -259,10 +279,48 @@ class PagedAttentionHook:
         blocks = self.block_tables[slot, blk_idx]
         blocks = jnp.where(valid, blocks, SCRATCH_BLOCK)
         offs = pos % self.block_size
+        if self.mode == "verify":
+            # snapshot what the write clobbers so rollback() can undo the
+            # rejected tail exactly — acceptance is only known after the
+            # forward, but the chunk-attention semantics need the draft
+            # K/V resident DURING it
+            old_k = self.k_pages[layer, blocks, offs]
+            old_v = self.v_pages[layer, blocks, offs]
+            self._saved.append((blocks, offs, old_k, old_v))
         kw = _flatten_heads(k).astype(self.k_pages.dtype)
         vw = _flatten_heads(v).astype(self.v_pages.dtype)
         self.k_pages = self.k_pages.at[layer, blocks, offs].set(kw)
         self.v_pages = self.v_pages.at[layer, blocks, offs].set(vw)
+
+    def rollback(self, n_keep) -> None:
+        """Restore every verify write PAST the accepted window (ISSUE 17).
+
+        Called after acceptance is computed, inside the same trace: query
+        row ``i`` of slot ``b`` keeps its written K/V iff ``i <
+        n_keep[b]``; every other row's destination is restored to the
+        snapshot ``_write_layer`` took.  Restores are steered like
+        writes: kept rows' restore targets flip to the scratch block
+        (their old values land somewhere nothing reads), so the scatter
+        stays fixed-shape with no branching, and rejected draft
+        positions never dirty the cache across dispatches.
+
+        Args:
+            n_keep: ``[B] int32`` accepted-row counts (the sampling
+                layer's ``n_emit``).
+        """
+        if self.mode != "verify":
+            raise ValueError(
+                f"rollback() is a verify-mode operation; hook mode is "
+                f"{self.mode!r}"
+            )
+        B, L = self.positions.shape
+        within = jnp.tile(jnp.arange(L, dtype=jnp.int32), B)
+        slot = jnp.repeat(jnp.arange(B, dtype=jnp.int32), L)
+        keep = within < n_keep.astype(jnp.int32)[slot]
+        for layer, (blocks, offs, old_k, old_v) in enumerate(self._saved):
+            blocks_r = jnp.where(keep, SCRATCH_BLOCK, blocks)
+            self.k_pages = self.k_pages.at[layer, blocks_r, offs].set(old_k)
+            self.v_pages = self.v_pages.at[layer, blocks_r, offs].set(old_v)
 
     # ----------------------------- attention --------------------------- #
 
@@ -295,6 +353,29 @@ class PagedAttentionHook:
                     self.v_pages[layer],
                     self.block_tables,
                     self.lengths,
+                )
+            if self.mode == "verify":
+                # speculative verify: S = k+1 query rows attend the paged
+                # prefix (draft K/V just written) under the chunk-style
+                # positional predicate; reference delegates to the chunk
+                # attention, pallas streams pages once for all S rows
+                if self.decode_impl == "pallas":
+                    return paged_verify_attention_pallas(
+                        q,
+                        self.k_pages[layer],
+                        self.v_pages[layer],
+                        self.block_tables,
+                        self.positions,
+                        pages_per_block=self.verify_pages_per_block,
+                        block_h=self.verify_block_h,
+                        interpret=self.decode_interpret,
+                    )
+                return paged_verify_attention(
+                    q,
+                    self.k_pages[layer],
+                    self.v_pages[layer],
+                    self.block_tables,
+                    self.positions,
                 )
             if self.mode == "chunk":
                 # chunked prefill: the chunk's K/V were just written, so

@@ -169,3 +169,98 @@ def sample_tokens(logits, keys, temperature, top_k, top_p):
         jnp.where(final > _NEG_INF * 0.5, final + g, _NEG_INF), axis=-1
     ).astype(jnp.int32)
     return jnp.where(temperature > 0, sampled, greedy)
+
+
+def speculative_sample_tokens(logits, key_data, temperature, top_k, top_p):
+    """Draw the S sequential target tokens a verify dispatch compares its
+    drafts against (ISSUE 17).
+
+    The verify program scores S = k+1 query positions per request in one
+    forward; the accept rule needs the token the NON-speculative engine
+    *would* have drawn at each of those positions — i.e. S sequential
+    draws from the same per-request key stream, each with the subkey the
+    plain decode loop would have used.  A ``lax.scan`` over the S
+    position axis performs exactly that: split once per position, draw
+    with the subkey, stack every intermediate key state so the caller
+    can rewind to "after n_emit splits" (:func:`select_key_data`) once
+    acceptance is known.  Temperature 0 rides :func:`sample_tokens`'s
+    exact-argmax route, so greedy verify draws are the raw argmax —
+    key splits still advance (and are then discarded by the greedy
+    engine-identity: greedy streams ignore the key anyway).
+
+    Args:
+        logits: ``[B, S, V]`` verify-program logits (position s predicts
+            the token AFTER query s).
+        key_data: ``[B, ...]`` raw per-slot key state (pre-draw).
+        temperature / top_k / top_p: ``[B]`` wire-encoded knobs, shared
+            by all S draws of a request (they are per-request, not
+            per-token).
+
+    Returns ``(targets, key_stack)``: ``targets[B, S] int32`` — the
+    model's true draw at each position; ``key_stack[S, B, ...]`` — the
+    per-slot key state after each split (``key_stack[i]`` = state after
+    ``i + 1`` splits, i.e. after ``i + 1`` tokens have been drawn).
+    """
+    S = logits.shape[1]
+
+    def step(kd, logit_s):
+        kd_next, sub = split_key_data(kd)
+        tok = sample_tokens(logit_s, sub, temperature, top_k, top_p)
+        return kd_next, (tok, kd_next)
+
+    _, (targets, key_stack) = jax.lax.scan(
+        step, key_data, jnp.moveaxis(logits, 1, 0), length=S
+    )
+    return jnp.moveaxis(targets, 0, 1), key_stack
+
+
+def accept_drafts(drafts, draft_lens, targets):
+    """Leading-exact-match acceptance over a verify batch.
+
+    Draft token ``drafts[b, i]`` is accepted iff it equals the model's
+    true sequential draw ``targets[b, i]`` AND every earlier draft
+    position was accepted (a rejection truncates the tail — later drafts
+    were conditioned on the rejected token's continuation).  Exact-match
+    verification keeps the emitted stream BIT-identical to the
+    non-speculative engine for every sampling mode: each emitted token
+    is ``targets[b, i]``, which was drawn from the true model
+    distribution with the correct sequential subkey — the draft only
+    decides how many of those draws one dispatch gets to keep.
+
+    Args:
+        drafts: ``[B, K] int32`` proposed tokens (garbage past
+            ``draft_lens``).
+        draft_lens: ``[B] int32`` valid draft tokens per slot (0..K).
+        targets: ``[B, S] int32`` with S >= K+1 — the sequential true
+            draws from :func:`speculative_sample_tokens`.
+
+    Returns ``n_emit [B] int32`` — tokens emitted this dispatch, in
+    ``1..K+1``: the accepted run plus the correction (or bonus) token
+    ``targets[b, n_emit-1]``.
+    """
+    B, K = drafts.shape
+    i = jnp.arange(K, dtype=jnp.int32)[None, :]
+    ok = (drafts == targets[:, :K]) & (i < draft_lens[:, None])
+    accepted = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=-1), axis=-1)
+    return (accepted + 1).astype(jnp.int32)
+
+
+def select_key_data(key_stack, n_emit):
+    """Rewind the scan's key states to "after ``n_emit`` splits" — the
+    key state the non-speculative engine would hold after emitting the
+    same tokens, so acceptance never desynchronizes a request's draw
+    stream (one split per EMITTED token, never per scored position).
+
+    Args:
+        key_stack: ``[S, B, ...]`` from :func:`speculative_sample_tokens`
+            (index i = state after i+1 splits).
+        n_emit: ``[B] int32`` in ``1..S``.
+
+    Returns ``[B, ...]`` key data to write back as the slot's state.
+    """
+    idx = (n_emit.astype(jnp.int32) - 1).reshape(
+        (-1,) + (1,) * (key_stack.ndim - 2)
+    )
+    return jnp.take_along_axis(
+        jnp.moveaxis(key_stack, 0, 1), idx[:, None], axis=1
+    )[:, 0]

@@ -39,6 +39,7 @@ from stoke_tpu.native import NativeBatcher
 from stoke_tpu.serving.kv_cache import SCRATCH_BLOCK, BlockAllocator
 from stoke_tpu.serving.sampling import SamplingParams
 from stoke_tpu.serving.slo import RequestSLO
+from stoke_tpu.serving.speculative import propose_draft
 
 
 @dataclass
@@ -322,6 +323,132 @@ class Scheduler:
         s.prefill_pos += self.prefill_chunk_tokens
         if s.prefill_pos >= s.request.prompt.size:
             s.prefill_pos = None
+
+    def next_chunks(self):
+        """Packed chunk batch (ISSUE 17): ONE dispatch services every
+        prefilling slot's next chunk, shaped ``[max_seqs, C]`` like the
+        verify program (fixed batch, per-row positions, scratch-steered
+        idle rows) instead of :meth:`next_chunk`'s one-slot-per-iteration
+        ``[1, C]``.  Per-iteration prefill work is still bounded — C
+        tokens per ROW, and rows were already paying the fixed dispatch
+        cost as dead decode slots.  Returns ``None`` when nothing is
+        prefilling, else ``(tokens [B, C], positions [B, C], tables
+        [B, MB], lengths [B], logit_idx [B], rows)`` — ``lengths`` the
+        per-row prompt length (the chunk-mode write predicate; idle rows
+        0 so every write steers to scratch), ``rows`` a list of
+        ``(slot, request, is_final)`` for the serviced slots."""
+        C = self.prefill_chunk_tokens
+        B = self.max_seqs
+        if not self.has_prefilling:
+            return None
+        tokens = np.zeros((B, C), np.int32)
+        positions = np.tile(np.arange(C, dtype=np.int32), (B, 1))
+        lengths = np.zeros(B, np.int32)
+        logit_idx = np.zeros(B, np.int32)
+        tables = self.block_tables.copy()
+        rows = []
+        for i, s in enumerate(self.slots):
+            if s.prefill_pos is None:
+                # idle or decoding row: all-scratch table (a decoding
+                # slot's real cache must be unreachable from this
+                # dispatch's padding writes), zero length, discarded out
+                tables[i, :] = SCRATCH_BLOCK
+                continue
+            req = s.request
+            plen = int(req.prompt.size)
+            start = s.prefill_pos
+            n = min(C, plen - start)
+            tokens[i, :n] = req.prompt[start : start + n]
+            positions[i, :] = np.minimum(
+                start + np.arange(C, dtype=np.int32), self.max_seq_len - 1
+            )
+            lengths[i] = plen
+            is_final = start + C >= plen
+            logit_idx[i] = plen - 1 - start if is_final else 0
+            rows.append((i, req, is_final))
+        return tokens, positions, tables, lengths, logit_idx, rows
+
+    # ------------------------ speculative decode ------------------------ #
+
+    def verify_batch(self, k: int, *, ngram_max: int, ngram_min: int):
+        """Fixed-shape speculative verify inputs (ISSUE 17): each decoding
+        slot's pending token plus up to ``k`` drafts from the host-side
+        prompt-lookup drafter, as S = k+1 query rows.
+
+        Drafts are truncated to ``remaining - 1`` (cap minus the pending
+        token) so a fully-accepted dispatch can never overshoot the
+        request's token budget or its admission-reserved blocks.  Idle
+        and still-prefilling rows ride along scratch-steered exactly like
+        :meth:`decode_batch`'s — zero write budget, all-scratch tables,
+        outputs discarded.
+
+        Returns ``(tokens [B, S], positions [B, S], tables [B, MB],
+        lengths [B], draft_lens [B])`` — ``lengths`` the verify write
+        budget (context + draft + 1), ``draft_lens`` the per-slot valid
+        draft counts the accept rule masks with.
+        """
+        B = self.max_seqs
+        S = k + 1
+        tokens = np.zeros((B, S), np.int32)
+        positions = np.tile(np.arange(S, dtype=np.int32), (B, 1))
+        lengths = np.zeros(B, np.int32)
+        draft_lens = np.zeros(B, np.int32)
+        tables = self.block_tables.copy()
+        for i, s in enumerate(self.slots):
+            if s.request is None:
+                continue
+            if s.prefill_pos is not None:
+                tables[i, :] = SCRATCH_BLOCK
+                continue
+            req = s.request
+            remaining = req.max_new_tokens - len(req.tokens)
+            budget = max(0, min(k, remaining - 1))
+            draft = propose_draft(
+                np.concatenate([req.prompt, np.asarray(req.tokens, np.int32)]),
+                budget,
+                ngram_max=ngram_max,
+                ngram_min=ngram_min,
+            )[:budget]
+            tokens[i, 0] = s.next_token
+            if draft:
+                tokens[i, 1 : 1 + len(draft)] = draft
+            positions[i, :] = np.minimum(
+                s.context_len + np.arange(S, dtype=np.int32),
+                self.max_seq_len - 1,
+            )
+            lengths[i] = s.context_len + len(draft) + 1
+            draft_lens[i] = len(draft)
+        return tokens, positions, tables, lengths, draft_lens
+
+    def commit_verify(
+        self, targets: np.ndarray, n_emit: np.ndarray, now: float
+    ) -> Tuple[np.ndarray, int]:
+        """Fold one verify dispatch's outputs into the slots: each live
+        slot emits its first ``n_emit[i]`` target tokens (the accepted
+        run plus the correction/bonus draw), stopping early at eos —
+        eviction frees the whole slot, so over-accepted cache rows past
+        an eos die with it.  Returns ``(committed [B], accepted)`` —
+        per-slot tokens actually committed (0 for idle rows) and the
+        total draft tokens that became output (``committed - 1`` per
+        live slot); with :meth:`verify_batch`'s ``draft_lens`` these
+        feed the ``serve/spec_*`` counters."""
+        committed = np.zeros(self.max_seqs, np.int32)
+        accepted = 0
+        for i, s in enumerate(self.slots):
+            if s.request is None or s.prefill_pos is not None:
+                continue
+            req = s.request
+            for j in range(int(n_emit[i])):
+                tok = int(targets[i, j])
+                s.context_len += 1  # query row j's K/V is now cached
+                req.tokens.append(tok)
+                s.next_token = tok
+                committed[i] += 1
+                if self._done(req):
+                    self._finish(i, now)
+                    break
+            accepted += max(int(committed[i]) - 1, 0)
+        return committed, accepted
 
     # --------------------------- decode state -------------------------- #
 

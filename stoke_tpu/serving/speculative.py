@@ -1,0 +1,76 @@
+"""Host-side self-speculative drafting for the serving engine (ISSUE 17).
+
+Speculative decoding needs a cheap guess at the next k tokens; this module
+is the guesser.  It is a **prompt-lookup / n-gram drafter**: the only
+model it consults is the request's own token history (prompt + everything
+emitted so far), which the scheduler already owns on the host — no second
+model, no new weights, no device work.  The bet is the one prompt-lookup
+decoding makes: generated text constantly re-quotes its own context
+(code, summaries, structured output, any loop the model falls into), so
+the continuation of the most recent earlier occurrence of the current
+tail n-gram is a strong draft.
+
+The drafter is allowed to be wrong — the verify program
+(``serving/engine.py``) scores every draft position against the real
+model in one dispatch and the accept rule keeps only the leading exact
+matches, so a bad draft costs nothing but the wasted query rows.  It is
+**not** allowed to be slow or to touch the device: `propose_draft` is
+plain Python over the host-side history and runs once per request per
+decode iteration.
+
+Knobs (``ServeConfig.speculative_ngram_max`` / ``speculative_ngram_min``)
+bound the matched tail length: longer matches are tried first (more
+specific ⇒ higher acceptance when they hit), falling back to shorter
+ones down to ``ngram_min``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+__all__ = ["propose_draft"]
+
+
+def propose_draft(
+    history: Sequence[int],
+    k: int,
+    *,
+    ngram_max: int = 3,
+    ngram_min: int = 1,
+) -> List[int]:
+    """Propose up to ``k`` draft tokens continuing ``history``.
+
+    For each n from ``ngram_max`` down to ``ngram_min``, the last n
+    tokens of ``history`` are the search pattern; the MOST RECENT earlier
+    occurrence of that pattern wins (recency tracks the local repetition
+    structure better than the first occurrence), and the tokens that
+    followed it are returned as the draft.  First n that matches wins —
+    longer patterns are more specific, so their continuations are
+    accepted more often.
+
+    Args:
+        history: the request's full token history, prompt + emitted, in
+            order.  The next real token continues this sequence.
+        k: maximum draft length (``ServeConfig.speculative_k``).
+        ngram_max / ngram_min: tail-pattern length bounds, inclusive.
+
+    Returns up to ``k`` proposed tokens (possibly empty — no match, or
+    history too short).  Never raises on degenerate inputs; config
+    validation happens in ``status.py``.
+    """
+    h = list(history)
+    L = len(h)
+    if k <= 0 or L < ngram_min + 1:
+        return []
+    for n in range(min(ngram_max, L - 1), ngram_min - 1, -1):
+        pattern = h[L - n:]
+        # most recent earlier occurrence: scan candidate start positions
+        # from the right; the match may overlap the tail's own window as
+        # long as it starts earlier (periodic text matches itself).
+        for start in range(L - n - 1, -1, -1):
+            if h[start:start + n] == pattern:
+                # start < L - n guarantees at least one continuation
+                # token; the continuation may run into the tail window
+                # itself — that is fine, those ARE the latest tokens.
+                return h[start + n:start + n + k]
+    return []

@@ -21,6 +21,15 @@ from typing import Dict, List, Optional
 
 from stoke_tpu.telemetry.registry import MetricsRegistry
 
+#: speculative-decoding JSONL fields (ISSUE 17) — emitted only by engines
+#: with ``speculative_k`` set (the default-OFF contract: non-speculative
+#: records carry zero new fields).  Pinned append-only by the
+#: ``analysis/manifests/wire_formats.json`` manifest.
+SPEC_FIELDS = (
+    "serve/spec_draft_tokens",
+    "serve/spec_accepted_tokens",
+)
+
 #: sample cap for the exact-percentile reservoirs (beyond it the oldest
 #: samples age out; p50/p99 then describe the trailing window)
 _MAX_SAMPLES = 8192
@@ -142,6 +151,30 @@ class ServeMetrics:
             "tpot_p50": registry.gauge("serve/tpot_p50_s"),
             "tpot_p99": registry.gauge("serve/tpot_p99_s"),
         }
+        # speculative counters (ISSUE 17): created by enable_speculative()
+        # so a non-speculative engine's registry (and JSONL records) carry
+        # zero speculative series
+        self.spec_active = False
+        self.spec_draft_tokens = None
+        self.spec_accepted_tokens = None
+
+    def enable_speculative(self) -> None:
+        """Arm the speculative-decoding instruments (ISSUE 17) — called at
+        engine construction when ``ServeConfig.speculative_k`` is set.
+        ``accepted / drafted`` is the acceptance rate;
+        ``tokens_out / decode_steps`` the accepted-tokens-per-dispatch
+        the bench arm reports."""
+        if self.spec_active:
+            return
+        self.spec_active = True
+        self.spec_draft_tokens = self.registry.counter(
+            "serve/spec_draft_tokens_total",
+            help="draft tokens scored by verify dispatches (ISSUE 17)",
+        )
+        self.spec_accepted_tokens = self.registry.counter(
+            "serve/spec_accepted_tokens_total",
+            help="draft tokens accepted into the output stream (ISSUE 17)",
+        )
 
     # ------------------------------ feeds ------------------------------ #
 
@@ -187,7 +220,7 @@ class ServeMetrics:
         registry read."""
         self.refresh_percentiles()
         pct = self.latency_percentiles()
-        return {
+        out = {
             "serve/requests": self.requests.value,
             "serve/completed": self.completed.value,
             "serve/tokens_out": self.tokens_out.value,
@@ -211,3 +244,11 @@ class ServeMetrics:
                 else None
             ),
         }
+        if self.spec_active:
+            # speculative block (ISSUE 17): absent — not null — without a
+            # speculative config, like the serve/slo_* block
+            out["serve/spec_draft_tokens"] = self.spec_draft_tokens.value
+            out["serve/spec_accepted_tokens"] = (
+                self.spec_accepted_tokens.value
+            )
+        return out

@@ -1117,6 +1117,85 @@ class StokeStatus:
                         f"set, got {v} (None = requests carry their own "
                         f"RequestSLO targets)"
                     )
+            # speculative decoding (ISSUE 17): same knob discipline as
+            # sampling — misconfigurations name the remedy, knobs a
+            # disabled feature would silently ignore are rejected
+            if cfg.speculative_k is not None:
+                if cfg.speculative_k < 1:
+                    return (
+                        f"ServeConfig.speculative_k must be >= 1 when set "
+                        f"(None = speculative decoding off), got "
+                        f"{cfg.speculative_k}"
+                    )
+                if not cfg.sampling:
+                    return (
+                        f"ServeConfig.speculative_k={cfg.speculative_k} "
+                        f"needs sampling=True — the verify program rides "
+                        f"the key-threaded sampling programs "
+                        f"(temperature=0.0 keeps exact greedy streams); "
+                        f"set sampling=True or drop speculative_k"
+                    )
+                if (
+                    cfg.prefill_chunk_tokens is not None
+                    and cfg.speculative_k + 1 > cfg.prefill_chunk_tokens
+                ):
+                    return (
+                        f"ServeConfig.speculative_k={cfg.speculative_k} "
+                        f"puts the verify query width (k+1="
+                        f"{cfg.speculative_k + 1}) over the chunk budget "
+                        f"prefill_chunk_tokens={cfg.prefill_chunk_tokens} "
+                        f"— the multi-token programs share that "
+                        f"per-iteration bound; shrink speculative_k or "
+                        f"raise prefill_chunk_tokens"
+                    )
+                if cfg.speculative_ngram_min < 1:
+                    return (
+                        f"ServeConfig.speculative_ngram_min must be >= 1, "
+                        f"got {cfg.speculative_ngram_min}"
+                    )
+                if cfg.speculative_ngram_max < cfg.speculative_ngram_min:
+                    return (
+                        f"ServeConfig.speculative_ngram_max="
+                        f"{cfg.speculative_ngram_max} < "
+                        f"speculative_ngram_min="
+                        f"{cfg.speculative_ngram_min} — the drafter's "
+                        f"n-gram range is empty"
+                    )
+            else:
+                if (
+                    cfg.speculative_ngram_max != 3
+                    or cfg.speculative_ngram_min != 1
+                ):
+                    return (
+                        "ServeConfig speculative drafter knobs set "
+                        "(speculative_ngram_max/speculative_ngram_min) "
+                        "but speculative_k=None — the non-speculative "
+                        "engine would silently ignore them; set "
+                        "speculative_k or drop the knobs"
+                    )
+            for field in ("verify_pages_per_block", "verify_block_h"):
+                v = getattr(cfg, field)
+                if v is None:
+                    continue
+                if v < 1:
+                    return (
+                        f"ServeConfig.{field} must be >= 1 when set, "
+                        f"got {v}"
+                    )
+                if cfg.speculative_k is None:
+                    return (
+                        f"ServeConfig.{field}={v} set but "
+                        f"speculative_k=None — only the speculative "
+                        f"verify kernel reads the verify block knobs; "
+                        f"set speculative_k or drop the knob"
+                    )
+                if cfg.decode_kernel != "pallas":
+                    return (
+                        f"ServeConfig.{field}={v} set but decode_kernel="
+                        f"{cfg.decode_kernel!r} — the verify block knobs "
+                        f"feed the pallas verify kernel; set "
+                        f"decode_kernel='pallas' or drop the knob"
+                    )
             return False
 
         def _remat_invalid(s):

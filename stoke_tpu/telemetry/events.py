@@ -194,6 +194,13 @@ STEP_EVENT_FIELDS: Dict[str, tuple] = {
     # unchunked engine — the fields still ride every serve record)
     "serve/prefill_chunks": (False, "nullable_number"),
     "serve/sampled_tokens": (False, "nullable_number"),
+    # speculative decoding (ISSUE 17; keys absent without a speculative
+    # config — ServeMetrics omits them until enable_speculative(), so a
+    # non-speculative engine's records are byte-identical to pre-ISSUE-17
+    # ones): draft tokens scored by verify dispatches and draft tokens
+    # accepted into the output stream (accepted/drafted = accept rate)
+    "serve/spec_draft_tokens": (False, "nullable_number"),
+    "serve/spec_accepted_tokens": (False, "nullable_number"),
     # SLO observatory (ISSUE 16; keys absent until a request carries a
     # RequestSLO — an SLO-free engine's records are byte-identical to
     # pre-ISSUE-16 ones): submitted/finished/violated counts over
@@ -267,6 +274,14 @@ SERVE_STEP_FIELDS = tuple(
 #: adds zero JSONL fields (the FLEET_REBALANCE_FIELDS discipline)
 SERVE_SLO_FIELDS = tuple(
     f for f in SERVE_STEP_FIELDS if f.startswith("serve/slo_")
+)
+
+#: the speculative-decoding subset (ISSUE 17): emitted ONLY by engines
+#: with ``ServeConfig.speculative_k`` set — ServeMetrics omits these keys
+#: until ``enable_speculative()``, and ``build_step_event`` honors the
+#: omission (the SERVE_SLO_FIELDS discipline)
+SERVE_SPEC_FIELDS = tuple(
+    f for f in SERVE_STEP_FIELDS if f.startswith("serve/spec_")
 )
 
 #: the per-layer-numerics subset (populated via ``build_step_event``'s

@@ -312,3 +312,68 @@ def test_emit_persisted_slo_columns_ride_stale_emit(ledger, capsys):
     assert out["slo_attainment_interactive"] == 0.875
     assert out["slo_attainment_batch"] == 1.0
     assert out["slo_goodput_tokens_per_s"] == 1400.0
+
+
+def test_emit_persisted_speculative_guard_is_symmetric(ledger, capsys):
+    """ISSUE 17 satellite: the serve_speculative config key follows the
+    serve_priority_mix pattern — a speculative capture is never
+    substituted for a default (single-token-decode) run, and a default
+    (pre-speculative, keyless) record still satisfies a default request."""
+    # direction 1: a speculative capture never satisfies a default run
+    bench.persist_result(
+        "gpt_small_serve_throughput",
+        {"value": 3000.0, "date": "2026-08-06", "backend": "tpu",
+         "serve": True, "serve_speculative": True,
+         "spec_accept_rate": 0.8, "accepted_tokens_per_dispatch": 2.5},
+    )
+    rc, out = _emit(
+        capsys, "gpt_small_serve_throughput",
+        requested={"serve_speculative": False},
+    )
+    assert rc == 1
+    assert "serve_speculative" in out["error"]
+    # direction 2: a default (untagged) record never satisfies a
+    # speculative run
+    bench.persist_result(
+        "gpt_small_serve_throughput",
+        {"value": 1000.0, "date": "2026-07-01", "backend": "tpu",
+         "serve": True},
+    )
+    rc, out = _emit(
+        capsys, "gpt_small_serve_throughput",
+        requested={"serve_speculative": True},
+    )
+    assert rc == 1
+    assert "serve_speculative" in out["error"]
+    # and a legacy keyless record satisfies a default request (absent
+    # normalizes to False — pre-ISSUE-17 serve decode was single-token)
+    rc, out = _emit(
+        capsys, "gpt_small_serve_throughput",
+        requested={"serve_speculative": False},
+    )
+    assert rc == 0 and out["value"] == 1000.0
+
+
+def test_emit_persisted_speculative_columns_ride_stale_emit(ledger, capsys):
+    """A re-cited speculative capture carries its acceptance/dispatch
+    descriptor so consumers of the stale number see what speculation
+    bought in that capture."""
+    bench.persist_result(
+        "gpt_small_serve_throughput",
+        {"value": 2500.0, "unit": "tokens/sec", "date": "2026-08-06",
+         "backend": "tpu", "serve": True, "serve_speculative": True,
+         "spec_accept_rate": 0.75, "accepted_tokens_per_dispatch": 2.25,
+         "effective_tpot_s": 0.004, "decode_dispatches": 100,
+         "decode_dispatches_baseline": 220},
+    )
+    rc, out = _emit(
+        capsys, "gpt_small_serve_throughput",
+        requested={"serve_speculative": True},
+    )
+    assert rc == 0
+    assert out["serve_speculative"] is True
+    assert out["spec_accept_rate"] == 0.75
+    assert out["accepted_tokens_per_dispatch"] == 2.25
+    assert out["effective_tpot_s"] == 0.004
+    assert out["decode_dispatches"] == 100
+    assert out["decode_dispatches_baseline"] == 220

@@ -1180,9 +1180,13 @@ def test_serve_event_fields_match_schema():
     ONE wire format — the new prefill_chunks/sampled_tokens fields ride
     both.  The serve/slo_* fields (ISSUE 16) are the schema's nullable
     tail: SLOTracker emits them only once a deadline-tagged request
-    exists, so ServeMetrics alone covers exactly the non-SLO slice."""
+    exists — and the serve/spec_* fields (ISSUE 17) likewise appear only
+    on a speculative engine — so a plain ServeMetrics covers exactly the
+    non-SLO non-speculative slice, and enable_speculative() grows the
+    block by exactly SERVE_SPEC_FIELDS."""
     from stoke_tpu.telemetry.events import (
         SERVE_SLO_FIELDS,
+        SERVE_SPEC_FIELDS,
         SERVE_STEP_FIELDS,
     )
     from stoke_tpu.telemetry.registry import MetricsRegistry
@@ -1191,9 +1195,16 @@ def test_serve_event_fields_match_schema():
 
     m = ServeMetrics(MetricsRegistry())
     fields = m.event_fields()
-    assert set(fields) == set(SERVE_STEP_FIELDS) - set(SERVE_SLO_FIELDS)
+    assert set(fields) == (
+        set(SERVE_STEP_FIELDS)
+        - set(SERVE_SLO_FIELDS)
+        - set(SERVE_SPEC_FIELDS)
+    )
     assert "serve/prefill_chunks" in fields
     assert "serve/sampled_tokens" in fields
+    m.enable_speculative()
+    spec_fields = m.event_fields()
+    assert set(spec_fields) == set(fields) | set(SERVE_SPEC_FIELDS)
 
 
 # --------------------------------------------------------------------------- #
