@@ -7,7 +7,7 @@
 CPU_ENV = env PYTHONPATH=$(CURDIR) JAX_PLATFORMS=cpu
 MESH_ENV = $(CPU_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-full test-fast test-telemetry test-collectives test-health test-attribution test-fleet test-autotune test-resilience test-zero test-serving test-tracing test-numerics test-elastic test-analysis lint autotune-smoke dryrun bench-smoke telemetry-smoke serve-smoke tpu-probe
+.PHONY: test test-full test-fast test-telemetry test-collectives test-health test-attribution test-fleet test-autotune test-resilience test-zero test-serving test-serve-cost test-tracing test-numerics test-elastic test-analysis lint autotune-smoke dryrun bench-smoke telemetry-smoke serve-smoke tpu-probe
 
 lint:            ## static analysis (ISSUE 15): invariant linter (jax-free), program auditor over the lowered step/serve programs, + generated-api drift check; CI runs this before pytest
 	python scripts/stoke_lint.py
@@ -52,6 +52,9 @@ test-zero:       ## ZeRO-parity quantized-collective tests only (sharded weight 
 
 test-serving:    ## serving-stack tests only (paged KV decode parity/continuous batching/quantization)
 	$(MESH_ENV) python -m pytest tests/ -x -q -m serving
+
+test-serve-cost: ## serve roofline-observatory tests only (cost-card recombination/TPOT ceilings/drift gate)
+	$(MESH_ENV) python -m pytest tests/ -x -q -m serve_cost
 
 test-tracing:    ## structured-tracing tests only (span ring/nesting/Perfetto schema/request timelines/rank merge)
 	$(MESH_ENV) python -m pytest tests/ -x -q -m tracing

@@ -48,6 +48,13 @@ A100_BASELINE_IMGS_PER_SEC = 20000.0
 #: greedy decode, mixed 8-64 token prompts) — the same "fixed constant
 #: estimate" role A100_BASELINE_IMGS_PER_SEC plays for the training headline
 A100_BASELINE_SERVE_TOKENS_PER_SEC = 2000.0
+#: serve roofline ceilings (ISSUE 18): datasheet v5e bf16 matmul peak and
+#: HBM bandwidth — what the serve cost columns (serve_mfu, hbm_bw_util,
+#: attainable_tpot_s) are computed against.  Host-side accounting only:
+#: the observatory never enters a program argument list, so the tokens/s
+#: headline is unaffected
+V5E_PEAK_TFLOPS = 197.0
+V5E_PEAK_HBM_GBPS = 819.0
 WATCHDOG_SECONDS = 1500
 PROBE_TIMEOUT = 120
 PROBE_ATTEMPTS = 3
@@ -210,6 +217,8 @@ def _emit_persisted(metric: str, capture_error: str,
                         "tpot_p99_s", "batch_fill_mean",
                         "kv_occupancy_peak", "quant_compression",
                         "quant_err_max", "quant_err_layer",
+                        "serve_mfu", "hbm_bw_util", "flops_per_token",
+                        "attainable_tpot_s",
                     )
                 }
                 if rec.get("serve")
@@ -525,7 +534,7 @@ def _serve_bench(args, tiny: bool) -> int:
 
     import jax
 
-    from stoke_tpu.configs import ServeConfig
+    from stoke_tpu.configs import AttributionConfig, ServeConfig
     from stoke_tpu.models.gpt import GPT
     from stoke_tpu.serving import RequestSLO, ServingEngine
     from stoke_tpu.utils import init_module
@@ -585,8 +594,20 @@ def _serve_bench(args, tiny: bool) -> int:
             temperature=0.8 if sampling else 0.0,
             top_p=0.9 if sampling else None,
             speculative_k=spec_k if speculative else None,
+            # roofline columns (ISSUE 18) ride every serve arm — the
+            # observatory is host-side bookkeeping, so the dispatched
+            # programs (and the tokens/s headline) are unchanged
+            cost_cards=True,
         )
-        return ServingEngine(model, variables["params"], cfg), cfg
+        attribution = AttributionConfig(
+            peak_tflops=V5E_PEAK_TFLOPS, peak_hbm_gbps=V5E_PEAK_HBM_GBPS
+        )
+        return (
+            ServingEngine(
+                model, variables["params"], cfg, attribution=attribution
+            ),
+            cfg,
+        )
 
     eng, cfg = build_engine(chunk, speculative=spec)
 
@@ -749,6 +770,22 @@ def _serve_bench(args, tiny: bool) -> int:
                 st.get("goodput_tokens", 0) / wall, 2
             )
 
+    # roofline columns (ISSUE 18): achieved-vs-attainable at the v5e
+    # peaks, from the engine's analytic cost cards
+    cost = eng.summary()["cost"]
+
+    def _cost_round(v, nd=6):
+        return None if v is None else round(v, nd)
+
+    cost_cols = {
+        "serve_mfu": _cost_round(cost.get("mfu")),
+        "hbm_bw_util": _cost_round(cost.get("hbm_bw_util")),
+        "flops_per_token": _cost_round(cost.get("flops_per_token"), 1),
+        "attainable_tpot_s": _cost_round(
+            cost.get("attainable_tpot_s"), 9
+        ),
+    }
+
     stall_unchunked = None
     if long_arm:
         # the comparison leg: same trace, chunking disabled — its stall
@@ -787,6 +824,7 @@ def _serve_bench(args, tiny: bool) -> int:
         ),
         **spec_cols,
         **slo_cols,
+        **cost_cols,
         "requests": n,
         "ttft_p50_s": round(pct["ttft_p50_s"], 6),
         "ttft_p99_s": round(pct["ttft_p99_s"], 6),
@@ -864,6 +902,7 @@ def _serve_bench(args, tiny: bool) -> int:
                 ),
                 **spec_cols,
                 **slo_cols,
+                **cost_cols,
                 "requests": n,
                 "ttft_p50_s": result["ttft_p50_s"],
                 "ttft_p99_s": result["ttft_p99_s"],

@@ -107,10 +107,84 @@ def probe(batch, *, cifar=True):
     return rec
 
 
+def probe_serving(max_seqs=8):
+    """Serving reachability row (ISSUE 18): the serve cost cards bound
+    what one v5e chip could do on the bench arm's GPT-small decode loop.
+    The decode program's roofline time at the v5e peaks is the attainable
+    TPOT, so ``max_seqs / attainable_tpot_s`` is the attainable steady-
+    state tokens/s/chip — exact arithmetic from the XLA cost analysis,
+    no tunnel needed (the CPU backend lowers the same programs).  The
+    measured leg cites the bench ledger's persisted on-chip serve
+    capture when one exists."""
+    import jax
+
+    from stoke_tpu.configs import AttributionConfig, ServeConfig
+    from stoke_tpu.models.gpt import GPT
+    from stoke_tpu.serving import ServingEngine
+    from stoke_tpu.utils import init_module
+
+    # the bench --serve non-tiny arm's geometry (bench.py build_engine)
+    model = GPT(
+        vocab_size=8192, size_name="small", max_len=512, dropout_rate=0.0
+    )
+    variables = init_module(
+        model, jax.random.PRNGKey(0), np.zeros((1, 8), np.int32),
+        train=False,
+    )
+    cfg = ServeConfig(
+        max_seqs=max_seqs, kv_block_size=16, max_seq_len=256,
+        max_new_tokens=8, prefill_pad_multiple=32, cost_cards=True,
+    )
+    eng = ServingEngine(
+        model, variables["params"], cfg,
+        attribution=AttributionConfig(
+            peak_tflops=V5E_BF16_PEAK_TFLOPS, peak_hbm_gbps=819.0
+        ),
+    )
+    r = np.random.default_rng(0)
+    for _ in range(2):  # one prefill bucket + the decode program
+        eng.submit(r.integers(1, 8192, size=24).astype(np.int32))
+    eng.run()
+    cost = eng.summary()["cost"]
+    att = cost["attainable_tpot_s"]
+    if att is None:
+        return None
+    rec = {
+        "probe": "reachability",
+        "config": f"gpt_small_serve (max_seqs={max_seqs})",
+        "flops_per_token": round(cost["flops_per_token"] or 0.0, 1),
+        "decode_bound": cost["decode_bound"],
+        "attainable_tpot_s": round(att, 9),
+        "attainable_tokens_per_sec_chip": round(max_seqs / att, 1),
+    }
+    # measured leg: the persisted on-chip bench capture, when one exists
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        import bench
+
+        ledger_rec = bench._load_results().get("gpt_small_serve_throughput")
+        if ledger_rec and bench.record_backend(ledger_rec) not in (
+            "cpu", "unknown"
+        ):
+            rec["measured_tokens_per_sec"] = ledger_rec["value"]
+            rec["roofline_fraction"] = round(
+                ledger_rec["value"] / (max_seqs / att), 4
+            )
+    except Exception:
+        pass
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batches", default="128,256,512,1024")
     ap.add_argument("--skip-224", action="store_true")
+    ap.add_argument(
+        "--skip-serve", action="store_true",
+        help="skip the serving reachability row (ISSUE 18)",
+    )
     args = ap.parse_args()
 
     rows = []
@@ -122,6 +196,7 @@ def main():
         rec = probe(64, cifar=False)
         if rec:
             rows.append(rec)
+    serve_row = None if args.skip_serve else probe_serving()
 
     # markdown for BENCH_NOTES.md / docs/performance.md
     print("\n| config | batch | MFLOPs/img | TFLOP/s @ measured (MFU) | "
@@ -137,6 +212,22 @@ def main():
             f"| {r['config']} | {r['batch']} | {r['mflops_per_img']} | "
             f"{meas} | {r['tflops_at_baseline_20k']} "
             f"({r['mfu_at_baseline_20k']:.1%}) |"
+        )
+    if serve_row:
+        # serving reachability (ISSUE 18): attainable tokens/s/chip at
+        # the v5e peaks from the decode-family cost card, beside the
+        # ledger's measured on-chip capture when one exists
+        meas = (
+            f"{serve_row['measured_tokens_per_sec']:.0f} tok/s "
+            f"({serve_row['roofline_fraction']:.1%} of roofline)"
+            if "measured_tokens_per_sec" in serve_row else "—"
+        )
+        print(
+            f"| {serve_row['config']} | — | "
+            f"{serve_row['flops_per_token'] / 1e6:.1f} MFLOPs/tok | "
+            f"{meas} | attainable "
+            f"{serve_row['attainable_tokens_per_sec_chip']:.0f} tok/s/chip "
+            f"({serve_row['decode_bound']}-bound) |"
         )
 
 

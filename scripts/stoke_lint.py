@@ -124,26 +124,89 @@ spec_cfg = ServeConfig(max_seqs=2, kv_block_size=8, max_seq_len=64,
 spec_eng = ServingEngine(gpt, variables["params"], spec_cfg)
 spec_eng.submit(np.array([5, 9, 3] * 7, np.int32))  # 21 tokens -> 2 chunks
 spec_eng.run()
+# plain chunked engine (ISSUE 18): serve_prefill_chunk is the one serve
+# program neither engine above dispatches (the speculative engine packs
+# its chunks) — drive it so the cost manifest pins all five programs
+chunk_cfg = ServeConfig(max_seqs=2, kv_block_size=8, max_seq_len=64,
+                        max_new_tokens=4, prefill_pad_multiple=16,
+                        prefill_chunk_tokens=16)
+chunk_eng = ServingEngine(gpt, variables["params"], chunk_cfg)
+chunk_eng.submit(np.array([5, 9, 3] * 7, np.int32))
+chunk_eng.run()
+
+# cost-drift gate (ISSUE 18): the committed analytic-cost manifest rides
+# in via STOKE_COST_MANIFEST; the worker also reports every serve spec's
+# measured cost so --update-costs can re-pin the manifest
+import os
+cost_manifest = None
+manifest_path = os.environ.get("STOKE_COST_MANIFEST")
+if manifest_path:
+    with open(manifest_path) as fh:
+        cost_manifest = json.load(fh)
+
+from stoke_tpu.analysis.program import audit_program_specs, spec_cost_entry
 
 findings = []
 programs = []
+notes = []
+costs = {}
 for st, serve_eng in ((s, eng), (s2, spec_eng)):
     before = st.dispatch_count
-    rep = st.audit(serve=serve_eng)
+    rep = st.audit(serve=serve_eng, cost_manifest=cost_manifest)
     assert st.dispatch_count == before, "audit dispatched a program"
     findings += [f.to_dict() for f in rep.findings]
     programs += rep.programs
-print(json.dumps({"programs": programs, "findings": findings}))
+    notes += rep.notes
+# the chunked engine rides a standalone serve-spec audit (its step-side
+# twin is already covered above)
+rep = audit_program_specs(chunk_eng.audit_specs(),
+                          cost_manifest=cost_manifest)
+findings += [f.to_dict() for f in rep.findings]
+programs += rep.programs
+# engines share programs (serve_decode is dispatched by two of them) —
+# one defect, one finding
+deduped, seen_f = [], set()
+for f in findings:
+    key = (f["rule"], f["file"], f["message"])
+    if key not in seen_f:
+        seen_f.add(key)
+        deduped.append(f)
+findings = deduped
+for serve_eng in (eng, spec_eng, chunk_eng):
+    for spec in serve_eng.audit_specs():
+        if spec.program in costs:
+            continue
+        entry = spec_cost_entry(spec)
+        if entry is not None:
+            costs[spec.program] = entry
+print(json.dumps({"programs": programs, "findings": findings,
+                  "notes": notes, "costs": costs}))
 """
 
+#: the committed analytic-cost manifest the drift gate compares against
+_COST_MANIFEST = os.path.join(
+    "stoke_tpu", "analysis", "manifests", "program_costs.json"
+)
 
-def run_program_audit(repo_root: str) -> dict:
+
+def run_program_audit(
+    repo_root: str, cost_manifest_path: str | None = None
+) -> dict:
     """Spawn the jax-side program audit with a pinned CPU environment;
-    returns the worker's JSON payload."""
+    returns the worker's JSON payload.  ``cost_manifest_path`` arms the
+    audit-cost-drift gate (defaults to the committed manifest when it
+    exists; pass "" to disarm)."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    if cost_manifest_path is None:
+        default = os.path.join(repo_root, _COST_MANIFEST)
+        cost_manifest_path = default if os.path.exists(default) else ""
+    if cost_manifest_path:
+        env["STOKE_COST_MANIFEST"] = os.path.abspath(cost_manifest_path)
+    else:
+        env.pop("STOKE_COST_MANIFEST", None)
     proc = subprocess.run(
         [sys.executable, "-c", _PROGRAM_WORKER],
         capture_output=True,
@@ -178,10 +241,31 @@ def main(argv=None) -> int:
         action="store_true",
         help="also run the live program audit (subprocess, CPU mesh)",
     )
+    ap.add_argument(
+        "--cost-manifest",
+        default=None,
+        metavar="PATH",
+        help="program-cost manifest for the audit-cost-drift gate "
+        "(default: the committed "
+        "stoke_tpu/analysis/manifests/program_costs.json; pass an "
+        "empty string to disarm)",
+    )
+    ap.add_argument(
+        "--update-costs",
+        action="store_true",
+        help="with --programs: rewrite the committed program-cost "
+        "manifest from the live engines' measured analytic costs "
+        "(run after an INTENTIONAL serve-program cost change)",
+    )
     args = ap.parse_args(argv)
     repo_root = os.path.abspath(args.repo_root)
     if not os.path.isdir(repo_root):
         print(f"stoke_lint: no such directory {repo_root!r}", file=sys.stderr)
+        return 2
+
+    if args.update_costs and not args.programs:
+        print("stoke_lint: --update-costs requires --programs",
+              file=sys.stderr)
         return 2
 
     inv = _load_invariants(repo_root)
@@ -189,12 +273,41 @@ def main(argv=None) -> int:
     programs = []
     if args.programs:
         try:
-            payload = run_program_audit(repo_root)
+            payload = run_program_audit(
+                repo_root,
+                # an update pass must MEASURE, not judge against the
+                # stale pins it is about to replace
+                cost_manifest_path="" if args.update_costs
+                else args.cost_manifest,
+            )
         except Exception as e:
             print(f"stoke_lint: {e}", file=sys.stderr)
             return 2
         findings += payload["findings"]
         programs = payload["programs"]
+        if args.update_costs:
+            manifest_path = os.path.join(repo_root, _COST_MANIFEST)
+            manifest = {
+                "_comment": [
+                    "ISSUE 18 analytic program-cost manifest: the",
+                    "audit-cost-drift gate re-lowers every serve program",
+                    "and compares its XLA cost analysis (FLOPs / bytes",
+                    "accessed) against these pins at matching argument-",
+                    "geometry signature.  Deviations beyond the tolerance",
+                    "fail CI in BOTH directions (golden-file semantics).",
+                    "Regenerate after an INTENTIONAL cost change with:",
+                    "  python scripts/stoke_lint.py --programs --update-costs",
+                ],
+                "tolerance": 0.05,
+                "programs": dict(sorted(payload["costs"].items())),
+            }
+            with open(manifest_path, "w") as fh:
+                json.dump(manifest, fh, indent=2)
+                fh.write("\n")
+            print(
+                f"stoke_lint: pinned {len(manifest['programs'])} "
+                f"program cost(s) -> {manifest_path}"
+            )
 
     if args.json:
         print(

@@ -73,6 +73,7 @@ def run_serve_cycle(sv_dir: str) -> dict:
     import jax as _jx
 
     from stoke_tpu import (
+        AttributionConfig,
         ServeConfig,
         Stoke,
         StokeOptimizer,
@@ -117,7 +118,13 @@ def run_serve_cycle(sv_dir: str) -> dict:
                 # decode iteration is a k-token verify dispatch; greedy
                 # streams stay bit-identical (asserted below)
                 speculative_k=3,
+                # ISSUE 18: the serve roofline observatory — cost cards
+                # at the dispatch funnel, the serve/cost_* JSONL block,
+                # and the verify-over-decode intensity uplift (asserted
+                # below; the AttributionConfig supplies the v5e peaks)
+                cost_cards=True,
             ),
+            AttributionConfig(peak_tflops=197.0, peak_hbm_gbps=819.0),
             # traced serve requests (ISSUE 10/13): the per-request
             # admission -> [chunks] -> prefill -> decode timelines are
             # parsed below
@@ -206,6 +213,22 @@ def run_serve_cycle(sv_dir: str) -> dict:
     )
     spec_drafted = sv_rec.get("serve/spec_draft_tokens") or 0.0
     spec_accepted = sv_rec.get("serve/spec_accepted_tokens") or 0.0
+    # ISSUE 18: the cost-card block and the roofline summary — analytic
+    # FLOPs/bytes accumulated at the dispatch funnel, decode-family
+    # classified memory-bound at the v5e peaks, and the verify program's
+    # intensity uplift over plain decode > 1 (the reference engine runs
+    # without cost_cards, so its summary block must stay inactive)
+    cost_summary = sv_eng.summary().get("cost", {})
+    cost_ok = (
+        (sv_rec.get("serve/cost_flops") or 0.0) > 0
+        and (sv_rec.get("serve/cost_bytes") or 0.0) > 0
+        and sv_rec.get("serve/cost_decode_bound") == "memory"
+        and (sv_rec.get("serve/cost_attainable_tpot_s") or 0.0) > 0
+        and sv_rec.get("serve/cost_flops_per_token") is not None
+        and cost_summary.get("active") is True
+        and (cost_summary.get("verify_intensity_uplift") or 0.0) > 1.0
+        and ref_eng.summary().get("cost", {}).get("active") is False
+    )
     ok = (
         all(
             len(sv_eng.scheduler.finished[rid].tokens) == 4
@@ -247,9 +270,13 @@ def run_serve_cycle(sv_dir: str) -> dict:
         and spec_drafted > 0
         and 0 < spec_accepted <= spec_drafted
         and greedy_identity
+        # ISSUE 18: cost-card / roofline wire evidence
+        and cost_ok
+        and "stoke_serve_cost_flops_total" in sv_prom
     )
     return {
         "ok": ok,
+        "cost_summary": cost_summary,
         "spec_drafted": spec_drafted,
         "spec_accepted": spec_accepted,
         "spec_accept_rate": (
@@ -722,8 +749,11 @@ def main() -> int:
         and (rec.get("numerics/per_group") or {}).keys() == {"w"}
         # default-OFF discipline (ISSUE 9): training records never carry
         # serve fields — and (ISSUE 12) a run without a NumericsConfig
-        # (the serve cycle's) never carries numerics fields
+        # (the serve cycle's) never carries numerics fields; the
+        # serve/cost_* block (ISSUE 18) rides the same contract, so a
+        # non-serve record is cost-free by construction
         and not any(k.startswith("serve/") for k in rec)
+        and not any(k.startswith("serve/cost_") for r in records for k in r)
         and not any(k.startswith("numerics/") for k in sv_rec)
     )
     print(json.dumps({
@@ -759,6 +789,11 @@ def main() -> int:
         "serve_slo_attainment": sv_rec.get("serve/slo_attainment"),
         "serve_slo_coverage": sv_result["slo_attribution"].get(
             "span_coverage"
+        ),
+        "serve_cost_decode_bound": sv_rec.get("serve/cost_decode_bound"),
+        "serve_cost_mfu": sv_rec.get("serve/cost_mfu"),
+        "serve_verify_intensity_uplift": sv_result["cost_summary"].get(
+            "verify_intensity_uplift"
         ),
         "numerics": "ok" if numerics_ok else "FAILED",
         "numerics_provenance": nm_rec.get("numerics/provenance_name"),
@@ -802,6 +837,15 @@ def serve_only() -> int:
         "spec_drafted": res["spec_drafted"],
         "spec_accepted": res["spec_accepted"],
         "spec_greedy_identity": res["greedy_identity"],
+        "serve_cost_decode_bound": res["record"].get(
+            "serve/cost_decode_bound"
+        ),
+        "serve_cost_attainable_tpot_s": res["record"].get(
+            "serve/cost_attainable_tpot_s"
+        ),
+        "serve_verify_intensity_uplift": res["cost_summary"].get(
+            "verify_intensity_uplift"
+        ),
         "trace_requests": sorted(res["spans_by_rid"]),
     }))
     return 0 if res["ok"] else 1

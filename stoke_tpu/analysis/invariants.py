@@ -96,6 +96,7 @@ JSONL_EMITTER_MODULES: Tuple[str, ...] = (
     "stoke_tpu/resilience.py",
     "stoke_tpu/serving/telemetry.py",
     "stoke_tpu/serving/slo.py",
+    "stoke_tpu/serving/roofline.py",
 )
 #: emitter function names the JSONL rule inspects
 _JSONL_EMITTER_FNS = ("event_fields", "_event_fields", "_base_event_fields")

@@ -49,6 +49,20 @@ Checks (rule ids; every finding names the remedy):
   drifted from the program), or manual collectives in an apply-family
   program with NO active transport (traffic nothing accounts —
   ``bytes_per_step`` would under-report the wire).
+- ``audit-cost-drift`` (ISSUE 18) — serve-program analytic cost vs the
+  committed ``analysis/manifests/program_costs.json`` manifest: each
+  serve spec is re-lowered for its XLA cost analysis (FLOPs / bytes
+  accessed — the same numbers the roofline observatory's cards carry)
+  and compared against the pinned entry at matching shape signature.
+  A relative deviation beyond the manifest tolerance fires IN BOTH
+  directions (golden-file semantics: a silent bloat is a perf
+  regression; a silent shrink means the pin is stale), so a refactor
+  that quietly inflates a serve program fails CI on CPU with no
+  hardware in the loop.  Unpinned serve programs fire too — a new
+  program must be pinned when it lands.  Signature mismatches (the
+  engine geometry changed) and backends without cost analysis are
+  NOTES, not findings: geometry changes re-pin via ``scripts/
+  stoke_lint.py --update-costs``.
 
 Program findings use a ``<jit:NAME>`` pseudo-file and line 0 — the
 "file" is the compiled program, not a source line.
@@ -56,6 +70,7 @@ Program findings use a ``<jit:NAME>`` pseudo-file and line 0 — the
 
 from __future__ import annotations
 
+import hashlib
 import re
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -244,6 +259,144 @@ def _arg_leaf_ranges(abstract_args: tuple) -> List[Tuple[int, int]]:
         ranges.append((pos, pos + n))
         pos += n
     return ranges
+
+
+# --------------------------------------------------------------------------- #
+# analytic program cost (ISSUE 18: the cost-drift gate's measurement leg)
+# --------------------------------------------------------------------------- #
+
+#: default relative FLOPs/bytes deviation above which audit-cost-drift
+#: fires (the manifest's "tolerance" key overrides; XLA's CPU cost model
+#: is deterministic for a fixed program, so the slack absorbs cross-
+#: version cost-model drift, not noise)
+DEFAULT_COST_TOLERANCE = 0.05
+
+
+def cost_signature(abstract_args: tuple) -> str:
+    """Stable digest of a spec's argument geometry (shapes + dtypes of
+    every array leaf, order-preserving).  Pinned beside the manifest's
+    analytic numbers so a cost comparison against a DIFFERENT engine
+    geometry (resized batch, longer context) reads as "not comparable"
+    instead of a false drift finding."""
+    leaves = [
+        (tuple(l.shape), str(l.dtype))
+        for l in jax.tree_util.tree_leaves(abstract_args)
+        if hasattr(l, "shape") and hasattr(l, "dtype")
+    ]
+    return hashlib.sha256(repr(leaves).encode()).hexdigest()[:16]
+
+
+def spec_cost_entry(spec: ProgramSpec) -> Optional[Dict[str, Any]]:
+    """One manifest entry for a serve spec: the XLA cost analysis of the
+    re-lowered program (lowering only — no compile, no dispatch) plus
+    the geometry signature.  None when the backend reports no cost
+    analysis (the gate then notes itself unchecked, never guesses)."""
+    from stoke_tpu.telemetry.attribution import cost_analysis_of
+
+    if not hasattr(spec.fn, "lower"):
+        return None
+    cost = cost_analysis_of(spec.fn, *spec.abstract_args)
+    if cost is None:
+        return None
+    flops = float(cost.get("flops", 0.0) or 0.0)
+    if flops <= 0:
+        return None
+    nbytes = cost.get("bytes accessed")
+    return {
+        "sig": cost_signature(spec.abstract_args),
+        "flops": flops,
+        "bytes_accessed": float(nbytes) if nbytes else None,
+    }
+
+
+def _rel_dev(measured: float, pinned: float) -> float:
+    return abs(measured - pinned) / max(abs(pinned), 1e-12)
+
+
+def _audit_cost_drift(
+    specs: Sequence[ProgramSpec],
+    report: "AuditReport",
+    cost_manifest: Dict[str, Any],
+    tolerance: float,
+) -> None:
+    """The cost-drift gate: serve specs' re-lowered analytic cost vs the
+    committed manifest, both directions (golden-file semantics)."""
+    pinned = cost_manifest.get("programs", {}) or {}
+    seen = set()
+    for spec in specs:
+        if spec.source != "serve" or spec.program in seen:
+            continue
+        seen.add(spec.program)
+        entry = spec_cost_entry(spec)
+        if entry is None:
+            report.notes.append(
+                f"audit-cost-drift not checked for {spec.program!r}: "
+                f"backend reports no XLA cost analysis"
+            )
+            continue
+        pin = pinned.get(spec.program)
+        if pin is None:
+            report.findings.append(
+                Finding(
+                    rule="audit-cost-drift",
+                    file=f"<jit:{spec.program}>",
+                    line=0,
+                    message=(
+                        f"serve program {spec.program!r} "
+                        f"({entry['flops']:.0f} analytic FLOPs) has no "
+                        f"pinned entry in the program-cost manifest — "
+                        f"its cost regressions would be invisible to CI"
+                    ),
+                    remedy=(
+                        "pin it: scripts/stoke_lint.py --update-costs "
+                        "rewrites analysis/manifests/program_costs.json "
+                        "from the live engines"
+                    ),
+                )
+            )
+            continue
+        if pin.get("sig") != entry["sig"]:
+            report.notes.append(
+                f"audit-cost-drift not checked for {spec.program!r}: "
+                f"argument geometry changed (sig {entry['sig']} vs "
+                f"pinned {pin.get('sig')}) — re-pin with "
+                f"scripts/stoke_lint.py --update-costs"
+            )
+            continue
+        for field_name, measured in (
+            ("flops", entry["flops"]),
+            ("bytes_accessed", entry["bytes_accessed"]),
+        ):
+            pinned_v = pin.get(field_name)
+            if pinned_v is None or measured is None:
+                continue
+            dev = _rel_dev(measured, pinned_v)
+            if dev <= tolerance:
+                continue
+            direction = "grew" if measured > pinned_v else "shrank"
+            report.findings.append(
+                Finding(
+                    rule="audit-cost-drift",
+                    file=f"<jit:{spec.program}>",
+                    line=0,
+                    message=(
+                        f"serve program {spec.program!r} analytic "
+                        f"{field_name} {direction} {dev:.1%} vs the "
+                        f"pinned manifest ({measured:.0f} vs "
+                        f"{pinned_v:.0f}, tolerance {tolerance:.0%}) at "
+                        f"UNCHANGED argument geometry — a refactor "
+                        f"changed what this program computes per "
+                        f"dispatch"
+                    ),
+                    remedy=(
+                        "if the cost change is intentional, re-pin with "
+                        "scripts/stoke_lint.py --update-costs; otherwise "
+                        "find the op the refactor added/dropped "
+                        "(compare lowered HLO against the last good "
+                        "commit)"
+                    ),
+                )
+            )
 
 
 # --------------------------------------------------------------------------- #
@@ -532,10 +685,17 @@ def audit_program_specs(
     churn_threshold: int = DEFAULT_CHURN_THRESHOLD,
     memo_cap: int = 1024,
     replicated_bytes_threshold: int = DEFAULT_REPLICATED_BYTES,
+    cost_manifest: Optional[Dict[str, Any]] = None,
+    cost_tolerance: Optional[float] = None,
 ) -> AuditReport:
     """Audit every recorded program spec.  Lowering/tracing only — no
     compile, no dispatch (``Stoke.audit()`` asserts dispatch-count
-    equality on top of this contract)."""
+    equality on top of this contract).
+
+    ``cost_manifest`` (ISSUE 18) arms the cost-drift gate: the parsed
+    ``analysis/manifests/program_costs.json`` dict, against which every
+    serve spec's re-lowered analytic FLOPs/bytes are compared
+    (``cost_tolerance`` overrides the manifest's own tolerance)."""
     report = AuditReport()
     for spec in specs:
         report.programs.append(spec.program)
@@ -584,5 +744,23 @@ def audit_program_specs(
                     "program count stays finite"
                 ),
             )
+        )
+    # cost-drift gate (ISSUE 18): armed only when a manifest is supplied
+    # — the rule applies to serve specs (step-program cost has no pinned
+    # manifest yet), and an unsupplied manifest is a note, not silence
+    if cost_manifest is not None:
+        tol = (
+            cost_tolerance
+            if cost_tolerance is not None
+            else float(
+                cost_manifest.get("tolerance", DEFAULT_COST_TOLERANCE)
+            )
+        )
+        _audit_cost_drift(specs, report, cost_manifest, tol)
+    elif any(spec.source == "serve" for spec in specs):
+        report.notes.append(
+            "audit-cost-drift not checked: no program-cost manifest "
+            "supplied (scripts/stoke_lint.py --programs passes the "
+            "committed analysis/manifests/program_costs.json)"
         )
     return report

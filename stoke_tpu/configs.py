@@ -1417,6 +1417,18 @@ class ServeConfig:
             ``serve_decode`` sweep).  Only read when ``speculative_k``
             is set AND ``decode_kernel="pallas"``; setting them outside
             that is a status error.
+        cost_cards: serve roofline observatory (ISSUE 18) — attach one
+            XLA cost analysis (FLOPs, bytes accessed, peak-HBM where
+            available) to every serve program at the dispatch funnel,
+            accumulate per-dispatch FLOP/byte counters, and derive the
+            decode roofline (attainable TPOT, MFU, HBM-bandwidth
+            utilization, per-program bound classification) plus the
+            ``serve/cost_*`` JSONL block and the SLO tracker's
+            TFLOP-goodput column.  Purely host-side: dispatched serve
+            programs stay HLO bit-identical either way.  Requires an
+            ``AttributionConfig`` in the run (its ``peak_tflops`` /
+            ``peak_hbm_gbps`` are the roofline's ceilings) — the engine
+            rejects ``cost_cards`` without one.
     """
 
     max_seqs: int = 8
@@ -1449,6 +1461,7 @@ class ServeConfig:
     speculative_ngram_min: int = 1
     verify_pages_per_block: Optional[int] = None
     verify_block_h: Optional[int] = None
+    cost_cards: bool = False
 
 
 @dataclass

@@ -1515,6 +1515,8 @@ class Stoke:
         *,
         replicated_bytes_threshold: Optional[int] = None,
         churn_threshold: Optional[int] = None,
+        cost_manifest: Optional[dict] = None,
+        cost_tolerance: Optional[float] = None,
     ):
         """Static program audit of this LIVE build (ISSUE 15): re-lower
         every step program the engine has dispatched (and, with
@@ -1553,6 +1555,12 @@ class Stoke:
             kwargs["replicated_bytes_threshold"] = replicated_bytes_threshold
         if churn_threshold is not None:
             kwargs["churn_threshold"] = churn_threshold
+        if cost_manifest is not None:
+            # cost-drift gate (ISSUE 18): re-lower each serve spec's cost
+            # against the committed analytic manifest
+            kwargs["cost_manifest"] = cost_manifest
+        if cost_tolerance is not None:
+            kwargs["cost_tolerance"] = cost_tolerance
         report = audit_program_specs(
             specs,
             transport_active=self._engine.transport.active,
@@ -3013,11 +3021,21 @@ class Stoke:
             # constructor-supplied config passes — with THIS run's device:
             # the pallas-decode-needs-TPU rule (ISSUE 13) must judge the
             # override against the facade's real backend, not the
-            # StokeStatus default
+            # StokeStatus default.  Cross-config rules (cost_cards needs
+            # an AttributionConfig, ISSUE 18) must see the run's real
+            # companions, so they ride along.
+            companions = [
+                c
+                for c in (
+                    self._status_obj.attribution_config,
+                    self._status_obj.telemetry_config,
+                )
+                if c is not None
+            ]
             StokeStatus(
                 batch_size_per_device=self._status_obj.batch_size,
                 device=self._status_obj.device,
-                configs=[scfg],
+                configs=[scfg] + companions,
             )
         module = getattr(self._adapter, "module", None)
         if not isinstance(module, GPT):
@@ -3039,6 +3057,13 @@ class Stoke:
             telemetry=self._telemetry,
             compile_cache=self._compile_cache,
             kv_sharding=kv_sharding,
+            # roofline observatory (ISSUE 18): the run's AttributionConfig
+            # carries the hardware peaks the serve roofline divides by
+            attribution=(
+                self._status_obj.attribution_config
+                if scfg.cost_cards
+                else None
+            ),
         )
         if self._numerics is not None and engine.quant_errors_by_group:
             # per-layer dequant-error attribution (ISSUE 12): the engine

@@ -134,6 +134,11 @@ class ServingEngine:
             HLO-keyed ledger for warm starts.
         kv_sharding: optional sharding for the page pool (mesh-placed
             serving; default = wherever ``jnp.zeros`` lands).
+        attribution: :class:`~stoke_tpu.configs.AttributionConfig`
+            supplying the hardware peaks (``peak_tflops`` /
+            ``peak_hbm_gbps``) the ISSUE 18 cost observatory rooflines
+            against — required when ``cfg.cost_cards`` is on (the facade
+            passes the run's config; standalone engines construct one).
     """
 
     def __init__(
@@ -146,6 +151,7 @@ class ServingEngine:
         telemetry=None,
         compile_cache=None,
         kv_sharding=None,
+        attribution=None,
     ):
         if not isinstance(model, GPT):
             raise TypeError(
@@ -183,6 +189,13 @@ class ServingEngine:
                 "verify program rides the key-threaded sampling programs "
                 "(temperature=0.0 keeps exact greedy streams); set "
                 "sampling=True or drop speculative_k"
+            )
+        if cfg.cost_cards and attribution is None:
+            raise ValueError(
+                "ServeConfig.cost_cards needs the hardware peaks an "
+                "AttributionConfig carries (peak_tflops / peak_hbm_gbps) "
+                "to roofline against — pass attribution= to the engine "
+                "(Stoke.serve() supplies the run's AttributionConfig)"
             )
         if _round_up(cfg.max_seq_len, cfg.prefill_pad_multiple) > model.max_len:
             raise ValueError(
@@ -266,6 +279,7 @@ class ServingEngine:
 
         # --- paged KV pool (pillar 1) ---
         max_blocks_per_seq = -(-cfg.max_seq_len // cfg.kv_block_size)
+        self._max_blocks_per_seq = max_blocks_per_seq
         num_blocks = (
             cfg.kv_blocks
             if cfg.kv_blocks is not None
@@ -389,6 +403,31 @@ class ServingEngine:
         self._donate = donate
         self._audit_specs: list = []
         self._audit_seen: set = set()
+
+        # serve roofline observatory (ISSUE 18): host-side cost cards
+        # over the dispatch funnel — never enters an argument list, so
+        # the compiled serve programs are HLO bit-identical with and
+        # without it (the audit_specs lowering test pins this); absent
+        # (None) entirely when cost_cards is off, so an unconfigured
+        # engine registers zero serve/cost series and its JSONL records
+        # carry zero new fields
+        self._cost = None
+        if cfg.cost_cards:
+            from stoke_tpu.serving.roofline import ServeCostObservatory
+
+            self._cost = ServeCostObservatory(
+                self.metrics,
+                attribution.peak_tflops,
+                attribution.peak_hbm_gbps,
+            )
+            if self._verify_jit is not None:
+                # a speculative engine never dispatches plain decode:
+                # lower it at the decode-batch shapes (abstract args
+                # only) so the verify program's intensity uplift has its
+                # counterfactual leg
+                self._cost.set_decode_baseline(
+                    self._decode_jit, self._decode_baseline_args()
+                )
 
         self._iterations = 0
         self._last_emit_iter = 0
@@ -617,6 +656,36 @@ class ServingEngine:
         ``audit_program_specs`` call)."""
         return list(self._audit_specs)
 
+    def _decode_baseline_args(self) -> tuple:
+        """Abstract (ShapeDtypeStruct) argument tuple for ONE plain-decode
+        dispatch at this engine's fixed batch shapes — what the roofline
+        observatory lowers on a speculative engine (which never dispatches
+        plain decode) so the verify program's arithmetic-intensity uplift
+        keeps its counterfactual leg.  Lowering-only: no arrays are
+        materialized and nothing executes."""
+        abstract = lambda leaf: jax.ShapeDtypeStruct(  # noqa: E731
+            leaf.shape, leaf.dtype
+        )
+        B = self.cfg.max_seqs
+        i32 = jnp.int32
+        args = (
+            jax.tree_util.tree_map(abstract, self.qparams),
+            abstract(self.cache.k_pages),
+            abstract(self.cache.v_pages),
+            jax.ShapeDtypeStruct((B,), i32),  # tokens
+            jax.ShapeDtypeStruct((B,), i32),  # positions
+            jax.ShapeDtypeStruct((B, self._max_blocks_per_seq), i32),
+            jax.ShapeDtypeStruct((B,), i32),  # context_lens
+        )
+        if self._sampling:
+            args += (
+                abstract(jnp.asarray(self._key_data)),
+                jax.ShapeDtypeStruct((B,), jnp.float32),  # temps
+                jax.ShapeDtypeStruct((B,), i32),  # top_ks
+                jax.ShapeDtypeStruct((B,), jnp.float32),  # top_ps
+            )
+        return args
+
     def _dispatch(self, program: str, fn, args: tuple):
         """Route one dispatch through the compile cache's program ledger
         (same contract as ``StepEngine._aot_call``): first dispatch per
@@ -624,6 +693,8 @@ class ServingEngine:
         starts resolve to an already-built fn and book reclaimed compile
         seconds — and every dispatch runs plain ``jax.jit`` semantics."""
         self._note_audit(program, fn, args)
+        if self._cost is not None:
+            self._cost.note_dispatch(program, fn, args, self._sig(args))
         cc = self._compile_cache
         if cc is not None:
             fn = cc.executable(program, (program, self._sig(args)), fn, args)
@@ -1155,6 +1226,12 @@ class ServingEngine:
         )
         if target > m.queue_s.value:
             m.queue_s.inc(target - m.queue_s.value)
+        if self._cost is not None:
+            # roofline gauges first, then hand the SLO tracker the current
+            # model-FLOPs-per-token so its TFLOP-goodput column tracks the
+            # same analytic cost the cards carry
+            self._cost.refresh_gauges()
+            self.slo.set_flops_per_token(self._cost.flops_per_token())
         self.slo.refresh_gauges()
 
     def emit_record(self) -> Optional[dict]:
@@ -1166,15 +1243,21 @@ class ServingEngine:
         self._last_emit_iter = self._iterations
         if self._telemetry is None or not self._telemetry.enabled:
             return None
-        # the serve/slo_* block is conditional: {} until the first
-        # SLO-tagged request, so an SLO-free engine's records carry zero
-        # new fields (build_step_event honors the omission)
+        # the serve/slo_* and serve/cost_* blocks are conditional: {} /
+        # absent until armed, so an engine without SLO-tagged requests or
+        # cost cards emits records with zero new fields (build_step_event
+        # honors the omission)
         return self._telemetry.record_step(
             step=self._iterations,
             window_steps=window,
             serve={
                 **self.metrics.event_fields(),
                 **self.slo.event_fields(),
+                **(
+                    self._cost.event_fields()
+                    if self._cost is not None
+                    else {}
+                ),
             },
         )
 
@@ -1213,4 +1296,14 @@ class ServingEngine:
             # SLO-tagged request arrives, else per-class attainment,
             # goodput-under-SLO, and queue-ETA forecasts
             "slo": self.slo.summary(),
+            # roofline observatory (ISSUE 18): {"active": False} without
+            # ServeConfig.cost_cards, else per-program cost cards, the
+            # decode roofline (attainable vs achieved TPOT, bound class),
+            # MFU / HBM-bandwidth utilization, and the verify-over-decode
+            # intensity uplift
+            "cost": (
+                self._cost.summary()
+                if self._cost is not None
+                else {"active": False}
+            ),
         }

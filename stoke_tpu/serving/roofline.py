@@ -1,0 +1,338 @@
+"""Serve roofline observatory (ISSUE 18): per-dispatch cost cards,
+bandwidth-bound TPOT ceilings, and achieved-vs-attainable accounting.
+
+The serving stack is five program families deep (prefill, chunk, packed
+chunk, decode, speculative verify) and reports tokens/s against an SLO
+(PR 16) — but nothing says how far any number sits from the hardware
+ceiling.  This module closes that gap with the PR-10 cost-card machinery
+(:class:`~stoke_tpu.telemetry.attribution.CostCardCache`, generalized
+with a ``counter_prefix``): one XLA cost analysis per (program, shape
+signature) at the engine's ``_dispatch`` funnel, per-dispatch FLOP/byte
+counters, and a decode **roofline** —
+
+- arithmetic intensity per program (FLOPs / byte accessed);
+- attainable TPOT = ``max(bytes/HBM-BW, flops/peak)`` of the decode-
+  family program at the ``AttributionConfig`` peaks, vs the achieved
+  per-dispatch decode wall (``decode_s / decode_steps``);
+- per-program bound classification (steady-state decode is memory-bound
+  on every real accelerator; the speculative verify program's k-token
+  intensity uplift over plain decode is a *measured* gauge here, closing
+  the loop on PR 17's tokens-per-dispatch claim);
+- model-FLOPs-per-token for the per-request cost attribution the
+  ``SLOTracker`` turns into an SLO-aware TFLOP-goodput column.
+
+Everything is host-side bookkeeping over programs the engine compiles
+anyway: with ``ServeConfig.cost_cards`` off nothing here is constructed
+and the dispatched serve programs are HLO bit-identical (the PR-16
+``audit_specs`` discipline); with it on, the only extra work is one
+``cost_analysis`` per program signature (lowering-only) plus one
+``memory_analysis`` compile per signature for the peak-HBM attachment.
+
+The ``serve/cost_*`` JSONL block is conditional — absent, not null,
+without the config (the ``serve/slo_*`` discipline), and its field list
+is pinned append-only in ``analysis/manifests/wire_formats.json``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+from stoke_tpu.telemetry.attribution import (
+    CostCard,
+    CostCardCache,
+    cost_analysis_of,
+    roofline_time_s,
+)
+
+#: the ``serve/cost_*`` JSONL field block (ISSUE 18) — emitted only by
+#: engines with ``ServeConfig.cost_cards`` on (the default-OFF contract:
+#: unconfigured records carry zero new fields).  Pinned append-only by
+#: the ``analysis/manifests/wire_formats.json`` manifest.
+COST_FIELDS = (
+    "serve/cost_flops",
+    "serve/cost_bytes",
+    "serve/cost_flops_per_token",
+    "serve/cost_mfu",
+    "serve/cost_hbm_bw_util",
+    "serve/cost_attainable_tpot_s",
+    "serve/cost_achieved_tpot_s",
+    "serve/cost_decode_intensity",
+    "serve/cost_verify_intensity",
+    "serve/cost_decode_bound",
+    "serve/cost_cards",
+)
+
+
+def program_bound(
+    card: Optional[CostCard], peak_tflops: float, peak_hbm_gbps: float
+) -> Optional[str]:
+    """Per-program roofline bound: ``"memory"`` when the bandwidth leg of
+    the roofline dominates (``bytes/BW >= flops/peak``), ``"compute"``
+    otherwise; ``None`` without both peaks or reported bytes.  Distinct
+    from the per-window :func:`~stoke_tpu.telemetry.attribution
+    .classify_bound` — this is an analytic property of ONE program, not a
+    measured window."""
+    if (
+        card is None
+        or not card.bytes_accessed
+        or card.flops <= 0
+        or peak_tflops <= 0
+        or peak_hbm_gbps <= 0
+    ):
+        return None
+    memory_s = card.bytes_accessed / (peak_hbm_gbps * 1e9)
+    compute_s = card.flops / (peak_tflops * 1e12)
+    return "memory" if memory_s >= compute_s else "compute"
+
+
+class ServeCostObservatory:
+    """Cost accounting over one serving engine's dispatch funnel.
+
+    Constructed by :class:`~stoke_tpu.serving.engine.ServingEngine` when
+    ``ServeConfig.cost_cards`` is on (the facade supplies the run's
+    ``AttributionConfig`` peaks).  The engine calls :meth:`note_dispatch`
+    beside its audit-spec funnel — one cost analysis per (program, shape
+    signature), every dispatch accumulating the card's analytic FLOPs /
+    bytes into the ``serve/cost/*`` registry counters — and
+    :meth:`refresh` at its gauge cadence.
+    """
+
+    #: the decode-family programs, in the order a per-token TPOT ceiling
+    #: should prefer them (a speculative engine dispatches verify INSTEAD
+    #: of plain decode — its ceiling is the verify program's)
+    _DECODE_FAMILY = ("serve_verify", "serve_decode")
+
+    def __init__(
+        self,
+        metrics,
+        peak_tflops: float = 0.0,
+        peak_hbm_gbps: float = 0.0,
+        *,
+        memory_analysis: bool = True,
+    ):
+        self.metrics = metrics
+        self.registry = metrics.registry
+        self.peak_tflops = float(peak_tflops)
+        self.peak_hbm_gbps = float(peak_hbm_gbps)
+        self.cache = CostCardCache(
+            metrics.registry,
+            peak_tflops,
+            peak_hbm_gbps,
+            counter_prefix="serve/cost",
+            memory_analysis=memory_analysis,
+        )
+        metrics.enable_cost()
+        #: dispatch count per (program, shape-signature) key — with the
+        #: per-key cards this recombines EXACTLY into the counter totals
+        #: (sum over keys of card.flops * dispatches == flops_total; the
+        #: tests/test_serve_cost.py recombination contract)
+        self.dispatch_counts: Dict[Tuple[str, Any], int] = {}
+        #: most recent card per program NAME (the roofline reads the
+        #: decode-family member)
+        self.program_cards: Dict[str, CostCard] = {}
+        #: analytic card of the plain-decode program a speculative engine
+        #: never dispatches — the comparison leg the verify-intensity
+        #: uplift is measured against (set by the engine, lowering-only)
+        self.baseline_decode_card: Optional[CostCard] = None
+
+    # ------------------------------ feeds ------------------------------ #
+
+    def note_dispatch(self, program: str, fn, args: tuple, sig) -> None:
+        """Per-dispatch hook (the engine's ``_dispatch`` funnel): first
+        call per (program, signature) runs the cost analysis; every call
+        books the card's analytic FLOPs/bytes and the dispatch count."""
+        key = (program, sig)
+        card = self.cache.note_dispatch(key, program, fn, args, steps=0)
+        self.dispatch_counts[key] = self.dispatch_counts.get(key, 0) + 1
+        if card is not None and card.flops > 0:
+            self.program_cards[program] = card
+
+    def set_decode_baseline(self, fn, abstract_args: tuple) -> None:
+        """Cost-analyze the plain-decode program from its ABSTRACT args
+        (lowering only — never dispatched, never counted): a speculative
+        engine routes every decode-family dispatch through the verify
+        program, so its intensity uplift needs this counterfactual."""
+        cost = cost_analysis_of(fn, *abstract_args)
+        if cost is None:
+            return
+        self.baseline_decode_card = CostCard.from_cost(
+            cost, "serve_decode", 0, self.peak_tflops, self.peak_hbm_gbps
+        )
+
+    # ----------------------------- derived ----------------------------- #
+
+    def _decode_card(self) -> Optional[CostCard]:
+        """The decode-family card the TPOT roofline reads (verify for a
+        speculative engine, plain decode otherwise)."""
+        for program in self._DECODE_FAMILY:
+            card = self.program_cards.get(program)
+            if card is not None:
+                return card
+        return None
+
+    def _plain_decode_card(self) -> Optional[CostCard]:
+        """Plain decode's card: live when this engine dispatches it, the
+        lowered baseline otherwise."""
+        return self.program_cards.get("serve_decode") or (
+            self.baseline_decode_card
+        )
+
+    def flops_total(self) -> float:
+        return self.registry.counter("serve/cost/flops_total").value
+
+    def bytes_total(self) -> float:
+        return self.registry.counter("serve/cost/bytes_total").value
+
+    def cards_total(self) -> int:
+        return int(
+            self.registry.counter("serve/cost/cost_cards_total").value
+        )
+
+    def flops_per_token(self) -> Optional[float]:
+        """Model FLOPs per EMITTED token — cumulative analytic FLOPs over
+        cumulative tokens out (prefill included: that IS the per-request
+        serving cost).  The per-request attribution the SLO TFLOP-goodput
+        column multiplies through."""
+        tokens = self.metrics.tokens_out.value
+        flops = self.flops_total()
+        if tokens <= 0 or flops <= 0:
+            return None
+        return flops / tokens
+
+    def attainable_tpot_s(self) -> Optional[float]:
+        """Roofline-optimal seconds per decode-family DISPATCH — the
+        bandwidth-bound TPOT ceiling (one token per request per dispatch
+        for plain decode; a verify dispatch's per-token ceiling is this
+        over its accepted-tokens-per-dispatch)."""
+        card = self._decode_card()
+        if card is None:
+            return None
+        return roofline_time_s(
+            card.flops,
+            card.bytes_accessed,
+            self.peak_tflops,
+            self.peak_hbm_gbps,
+        )
+
+    def achieved_tpot_s(self) -> Optional[float]:
+        """Measured decode wall per dispatch (same unit as
+        :meth:`attainable_tpot_s`; their ratio is the roofline gap)."""
+        steps = self.metrics.decode_steps.value
+        if steps <= 0:
+            return None
+        return self.metrics.decode_s.value / steps
+
+    def decode_intensity(self) -> Optional[float]:
+        card = self._plain_decode_card()
+        return card.intensity if card is not None else None
+
+    def verify_intensity(self) -> Optional[float]:
+        card = self.program_cards.get("serve_verify")
+        return card.intensity if card is not None else None
+
+    def decode_bound(self) -> Optional[str]:
+        """Analytic bound class of the decode-family program ("memory" /
+        "compute") — steady-state decode should classify memory-bound."""
+        return program_bound(
+            self._decode_card(), self.peak_tflops, self.peak_hbm_gbps
+        )
+
+    def mfu(self) -> Optional[float]:
+        """Serve MFU: analytic FLOPs over dispatch-BUSY wall seconds
+        (prefill + decode — queue/idle time excluded: an empty engine is
+        idle, not slow) against the configured peak."""
+        busy = (
+            self.metrics.prefill_s.value + self.metrics.decode_s.value
+        )
+        flops = self.flops_total()
+        if busy <= 0 or flops <= 0 or self.peak_tflops <= 0:
+            return None
+        return flops / busy / 1e12 / self.peak_tflops
+
+    def hbm_bw_util(self) -> Optional[float]:
+        """HBM bandwidth utilization over dispatch-busy seconds."""
+        busy = (
+            self.metrics.prefill_s.value + self.metrics.decode_s.value
+        )
+        nbytes = self.bytes_total()
+        if busy <= 0 or nbytes <= 0 or self.peak_hbm_gbps <= 0:
+            return None
+        return nbytes / busy / (self.peak_hbm_gbps * 1e9)
+
+    # ----------------------------- gauges ------------------------------ #
+
+    def refresh_gauges(self) -> None:
+        """Publish the achieved-vs-attainable gauges (engine gauge
+        cadence) and feed the SLO tracker's per-token cost."""
+        reg = self.registry
+        for name, v in (
+            ("serve/cost/mfu", self.mfu()),
+            ("serve/cost/hbm_bw_util", self.hbm_bw_util()),
+            ("serve/cost/attainable_tpot_s", self.attainable_tpot_s()),
+            ("serve/cost/achieved_tpot_s", self.achieved_tpot_s()),
+            ("serve/cost/flops_per_token", self.flops_per_token()),
+            ("serve/cost/decode_intensity", self.decode_intensity()),
+            ("serve/cost/verify_intensity", self.verify_intensity()),
+        ):
+            if v is not None:
+                reg.gauge(name).set(v)
+
+    # --------------------------- JSONL fields --------------------------- #
+
+    def event_fields(self) -> Dict[str, Any]:
+        """The conditional ``serve/cost_*`` block of one JSONL serve
+        record — only engines constructed with ``cost_cards`` carry an
+        observatory at all, so unconfigured records stay byte-identical
+        to pre-ISSUE-18 ones (``build_step_event`` honors the omission,
+        the ``serve/slo_*`` discipline)."""
+        return {
+            "serve/cost_flops": self.flops_total(),
+            "serve/cost_bytes": self.bytes_total(),
+            "serve/cost_flops_per_token": self.flops_per_token(),
+            "serve/cost_mfu": self.mfu(),
+            "serve/cost_hbm_bw_util": self.hbm_bw_util(),
+            "serve/cost_attainable_tpot_s": self.attainable_tpot_s(),
+            "serve/cost_achieved_tpot_s": self.achieved_tpot_s(),
+            "serve/cost_decode_intensity": self.decode_intensity(),
+            "serve/cost_verify_intensity": self.verify_intensity(),
+            "serve/cost_decode_bound": self.decode_bound(),
+            "serve/cost_cards": float(self.cards_total()),
+        }
+
+    # ----------------------------- summary ----------------------------- #
+
+    def summary(self) -> Dict[str, Any]:
+        """The cost block of ``ServingEngine.summary()``: per-program
+        cards, the decode roofline, and the verify-over-decode intensity
+        uplift (None until both cards exist)."""
+        decode_i = self.decode_intensity()
+        verify_i = self.verify_intensity()
+        return {
+            "active": True,
+            "peak_tflops": self.peak_tflops,
+            "peak_hbm_gbps": self.peak_hbm_gbps,
+            "flops_total": self.flops_total(),
+            "bytes_total": self.bytes_total(),
+            "flops_per_token": self.flops_per_token(),
+            "mfu": self.mfu(),
+            "hbm_bw_util": self.hbm_bw_util(),
+            "attainable_tpot_s": self.attainable_tpot_s(),
+            "achieved_tpot_s": self.achieved_tpot_s(),
+            "decode_bound": self.decode_bound(),
+            "decode_intensity": decode_i,
+            "verify_intensity": verify_i,
+            "verify_intensity_uplift": (
+                verify_i / decode_i
+                if verify_i is not None and decode_i
+                else None
+            ),
+            "cards": {
+                program: card.to_dict()
+                for program, card in sorted(self.program_cards.items())
+            },
+            "baseline_decode_card": (
+                self.baseline_decode_card.to_dict()
+                if self.baseline_decode_card is not None
+                else None
+            ),
+        }

@@ -226,6 +226,11 @@ class SLOTracker:
         self._instruments = None
         self._class_gauges: Dict[str, Dict[str, Any]] = {}
         self._t0: Optional[float] = None
+        # model-FLOPs-per-token from the cost observatory (ISSUE 18):
+        # None without ServeConfig.cost_cards, so the TFLOP-goodput
+        # column stays absent and SLO-only records remain byte-identical
+        # to pre-ISSUE-18 ones
+        self._flops_per_token: Optional[float] = None
 
     # ----------------------------- state ------------------------------- #
 
@@ -256,6 +261,24 @@ class SLOTracker:
         now = time.perf_counter() if now is None else now
         wall = max(now - self._t0, 1e-9)
         return self._totals().goodput_tokens / wall
+
+    def set_flops_per_token(self, v: Optional[float]) -> None:
+        """Install the cost observatory's model-FLOPs-per-token (ISSUE
+        18; engine gauge cadence) — arms the SLO-aware TFLOP-goodput
+        column in :meth:`event_fields` / :meth:`summary`."""
+        self._flops_per_token = v
+
+    def goodput_tflops_per_s(self, now: Optional[float] = None):
+        """SLO-aware TFLOP goodput: model TFLOPs of tokens whose request
+        MET its deadline, per second of SLO-tracked wall clock — the
+        utilization-denominated goodput the cost observatory arms (None
+        without ``ServeConfig.cost_cards`` or before any tokens)."""
+        if self._flops_per_token is None:
+            return None
+        gp = self.goodput_tokens_per_s(now)
+        if gp is None:
+            return None
+        return gp * self._flops_per_token / 1e12
 
     # ------------------------------ feeds ------------------------------ #
 
@@ -430,6 +453,14 @@ class SLOTracker:
         gp = self.goodput_tokens_per_s(now)
         if gp is not None:
             ins["goodput"].set(gp)
+        tf = self.goodput_tflops_per_s(now)
+        if tf is not None:
+            # registered lazily: the series exists only when the cost
+            # observatory armed a per-token cost (ISSUE 18 default-OFF)
+            self.registry.gauge(
+                "serve/slo_goodput_tflops_per_s",
+                help="TFLOPs/s from requests that met their SLO",
+            ).set(tf)
         hr = self.headroom_min_s(now)
         if hr is not None:
             ins["headroom"].set(hr)
@@ -463,7 +494,7 @@ class SLOTracker:
             return {}
         now = time.perf_counter()
         total = self._totals()
-        return {
+        out: Dict[str, Any] = {
             "serve/slo_requests": float(total.requests),
             "serve/slo_finished": float(total.finished),
             "serve/slo_violations": float(total.violated),
@@ -483,6 +514,14 @@ class SLOTracker:
                 self.partial_attributions
             ),
         }
+        if self._flops_per_token is not None:
+            # TFLOP-goodput column (ISSUE 18): rides only when the cost
+            # observatory armed a per-token cost, so an SLO-only engine's
+            # records stay byte-identical to pre-ISSUE-18 ones
+            out["serve/slo_goodput_tflops_per_s"] = (
+                self.goodput_tflops_per_s(now)
+            )
+        return out
 
     # ----------------------------- summary ----------------------------- #
 
@@ -494,7 +533,7 @@ class SLOTracker:
         if not self.active:
             return {"active": False}
         total = self._totals()
-        return {
+        out: Dict[str, Any] = {
             "active": True,
             "requests": total.requests,
             "finished": total.finished,
@@ -534,3 +573,6 @@ class SLOTracker:
                 for cls, st in sorted(self.by_class.items())
             },
         }
+        if self._flops_per_token is not None:
+            out["goodput_tflops_per_s"] = self.goodput_tflops_per_s()
+        return out

@@ -157,6 +157,12 @@ class ServeMetrics:
         self.spec_active = False
         self.spec_draft_tokens = None
         self.spec_accepted_tokens = None
+        # cost-card counters (ISSUE 18): created by enable_cost() so an
+        # engine without ServeConfig.cost_cards registers zero serve/cost
+        # series (same default-OFF contract as the speculative block)
+        self.cost_active = False
+        self.cost_flops = None
+        self.cost_bytes = None
 
     def enable_speculative(self) -> None:
         """Arm the speculative-decoding instruments (ISSUE 17) — called at
@@ -174,6 +180,26 @@ class ServeMetrics:
         self.spec_accepted_tokens = self.registry.counter(
             "serve/spec_accepted_tokens_total",
             help="draft tokens accepted into the output stream (ISSUE 17)",
+        )
+
+    def enable_cost(self) -> None:
+        """Arm the per-dispatch cost counters (ISSUE 18) — called by the
+        :class:`~stoke_tpu.serving.roofline.ServeCostObservatory` an
+        engine with ``ServeConfig.cost_cards`` constructs.  The counters
+        are the SAME registry series the observatory's ``CostCardCache``
+        (``counter_prefix="serve/cost"``) accumulates into — registry
+        instruments are cached by name — so ``cost_flops.value`` is the
+        analytic-FLOPs-dispatched total the recombination tests pin."""
+        if self.cost_active:
+            return
+        self.cost_active = True
+        self.cost_flops = self.registry.counter(
+            "serve/cost/flops_total",
+            help="analytic FLOPs dispatched",
+        )
+        self.cost_bytes = self.registry.counter(
+            "serve/cost/bytes_total",
+            help="analytic bytes accessed by dispatches",
         )
 
     # ------------------------------ feeds ------------------------------ #

@@ -1196,6 +1196,27 @@ class StokeStatus:
                         f"feed the pallas verify kernel; set "
                         f"decode_kernel='pallas' or drop the knob"
                     )
+            # roofline observatory (ISSUE 18): the cost cards divide by
+            # hardware peaks — both roofline legs need a ceiling, so an
+            # AttributionConfig with a positive HBM bandwidth is required
+            # (peak_tflops > 0 the attribution rule already enforces)
+            if cfg.cost_cards:
+                attr = self._configs.get("AttributionConfig")
+                if attr is None:
+                    return (
+                        "ServeConfig.cost_cards=True requires an "
+                        "AttributionConfig — the serve roofline divides "
+                        "by its peak_tflops / peak_hbm_gbps ceilings; "
+                        "add one or drop cost_cards"
+                    )
+                if attr.peak_hbm_gbps <= 0:
+                    return (
+                        f"ServeConfig.cost_cards=True needs "
+                        f"AttributionConfig.peak_hbm_gbps > 0 (the "
+                        f"memory leg of the decode roofline — attainable "
+                        f"TPOT is bandwidth-bound), got "
+                        f"{attr.peak_hbm_gbps}"
+                    )
             return False
 
         def _remat_invalid(s):

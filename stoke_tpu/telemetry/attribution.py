@@ -129,6 +129,28 @@ def cost_analysis_of(fn, *args, backend: Optional[str] = None):
     return cost
 
 
+def memory_analysis_bytes(fn, *args) -> Optional[float]:
+    """Best-effort peak-HBM estimate of jitted ``fn`` at ``args`` from
+    the compiled executable's ``memory_analysis()`` (argument + output +
+    temp, minus donated aliases).  Unlike :func:`cost_analysis_of` this
+    REQUIRES a compile, so callers pay it only on explicit opt-in (the
+    serve roofline observatory's per-program cards); ``None`` whenever
+    the backend or jax version cannot answer."""
+    try:
+        stats = fn.lower(*args).compile().memory_analysis()
+        if stats is None:
+            return None
+        total = (
+            float(stats.argument_size_in_bytes)
+            + float(stats.output_size_in_bytes)
+            + float(stats.temp_size_in_bytes)
+            - float(stats.alias_size_in_bytes)
+        )
+        return total if total > 0 else None
+    except Exception:
+        return None
+
+
 def _note_cost_unavailable(backend: str, reason) -> None:
     with _cost_warn_lock:
         if backend in _COST_UNAVAILABLE_BACKENDS:
@@ -156,6 +178,17 @@ class CostCard:
     bytes_accessed: Optional[float] # per dispatch (None when unreported)
     steps: int                      # optimizer steps per dispatch
     optimal_time_s: Optional[float] = None  # roofline bound per dispatch
+    #: compiled peak-HBM estimate (memory_analysis; None unless a caller
+    #: opted into the extra AOT compile — see memory_analysis_bytes)
+    peak_hbm_bytes: Optional[float] = None
+
+    @property
+    def intensity(self) -> Optional[float]:
+        """Arithmetic intensity (FLOPs per byte accessed) — the roofline
+        x-axis; None when XLA did not report bytes."""
+        if not self.bytes_accessed or self.flops <= 0:
+            return None
+        return self.flops / self.bytes_accessed
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -164,6 +197,8 @@ class CostCard:
             "bytes_accessed": self.bytes_accessed,
             "steps_per_dispatch": self.steps,
             "optimal_time_s": self.optimal_time_s,
+            "intensity": self.intensity,
+            "peak_hbm_bytes": self.peak_hbm_bytes,
         }
 
     @classmethod
@@ -272,26 +307,38 @@ class CostCardCache:
     _MAX_CARDS = 1024
 
     def __init__(self, registry, peak_tflops: float = 0.0,
-                 peak_hbm_gbps: float = 0.0):
+                 peak_hbm_gbps: float = 0.0, counter_prefix: str = "attr",
+                 memory_analysis: bool = False):
         self.registry = registry
         self.peak_tflops = float(peak_tflops)
         self.peak_hbm_gbps = float(peak_hbm_gbps)
+        #: registry namespace for the per-dispatch counters — "attr" for
+        #: the training monitor (wire-stable names), "serve/cost" for the
+        #: ISSUE 18 serve roofline observatory riding the same machinery
+        self.counter_prefix = counter_prefix
+        #: opt-in compiled peak-HBM attachment (one extra AOT compile per
+        #: distinct program signature — never on by default: training
+        #: attribution stays lowering-only)
+        self.memory_analysis = bool(memory_analysis)
         self.cards: Dict[Any, CostCard] = {}
         self.cost_analysis_runs = 0  # test hook: one per distinct key
         self._program_fallback: Dict[str, CostCard] = {}
         self._lock = threading.Lock()
         registry.counter(
-            "attr/flops_total", help="analytic FLOPs dispatched"
+            f"{counter_prefix}/flops_total",
+            help="analytic FLOPs dispatched",
         )
         registry.counter(
-            "attr/bytes_total", help="analytic bytes accessed by dispatches"
+            f"{counter_prefix}/bytes_total",
+            help="analytic bytes accessed by dispatches",
         )
         registry.counter(
-            "attr/optimal_s_total",
+            f"{counter_prefix}/optimal_s_total",
             help="roofline-optimal seconds of dispatched programs",
         )
         registry.counter(
-            "attr/cost_cards_total", help="distinct step programs analyzed"
+            f"{counter_prefix}/cost_cards_total",
+            help="distinct programs analyzed",
         )
 
     def note_dispatch(self, key, program: str, fn, args: tuple,
@@ -315,11 +362,14 @@ class CostCardCache:
                 card = self._analyze(key, program, fn, args, steps)
         if card is None:
             return None
-        self.registry.counter("attr/flops_total").inc(card.flops)
+        prefix = self.counter_prefix
+        self.registry.counter(f"{prefix}/flops_total").inc(card.flops)
         if card.bytes_accessed:
-            self.registry.counter("attr/bytes_total").inc(card.bytes_accessed)
+            self.registry.counter(f"{prefix}/bytes_total").inc(
+                card.bytes_accessed
+            )
         if card.optimal_time_s:
-            self.registry.counter("attr/optimal_s_total").inc(
+            self.registry.counter(f"{prefix}/optimal_s_total").inc(
                 card.optimal_time_s
             )
         return card
@@ -354,7 +404,11 @@ class CostCardCache:
                     cost, program, steps, self.peak_tflops,
                     self.peak_hbm_gbps,
                 )
-                self.registry.counter("attr/cost_cards_total").inc()
+                if self.memory_analysis:
+                    card.peak_hbm_bytes = memory_analysis_bytes(fn, *args)
+                self.registry.counter(
+                    f"{self.counter_prefix}/cost_cards_total"
+                ).inc()
                 self._program_fallback[program] = card
             self.cards[key] = card
             return card

@@ -221,6 +221,34 @@ STEP_EVENT_FIELDS: Dict[str, tuple] = {
     "serve/slo_queue_eta_s": (False, "nullable_number"),
     "serve/slo_headroom_min_s": (False, "nullable_number"),
     "serve/slo_partial_attributions": (False, "nullable_number"),
+    # SLO-aware TFLOP goodput (ISSUE 18; key absent unless BOTH the SLO
+    # observatory is active AND ServeConfig.cost_cards armed a per-token
+    # cost — an SLO-only engine's records stay byte-identical to
+    # pre-ISSUE-18 ones)
+    "serve/slo_goodput_tflops_per_s": (False, "nullable_number"),
+    # serve roofline / cost accounting (ISSUE 18; keys absent without
+    # ServeConfig.cost_cards — an unconfigured engine's records are
+    # byte-identical to pre-ISSUE-18 ones): cumulative analytic FLOPs /
+    # bytes dispatched (XLA cost analysis per program signature, fed per
+    # dispatch), model-FLOPs-per-emitted-token, MFU and HBM-bandwidth
+    # utilization over dispatch-busy seconds, the decode roofline's
+    # attainable per-dispatch TPOT (max of the compute- and bandwidth-
+    # limited bounds at the AttributionConfig peaks) vs the achieved
+    # decode wall per dispatch, arithmetic intensity of plain decode and
+    # of the speculative verify program (the PR-17 k-token uplift,
+    # measured), the decode-family program's analytic bound class
+    # ("memory"/"compute"), and the count of distinct programs analyzed
+    "serve/cost_flops": (False, "nullable_number"),
+    "serve/cost_bytes": (False, "nullable_number"),
+    "serve/cost_flops_per_token": (False, "nullable_number"),
+    "serve/cost_mfu": (False, "nullable_number"),
+    "serve/cost_hbm_bw_util": (False, "nullable_number"),
+    "serve/cost_attainable_tpot_s": (False, "nullable_number"),
+    "serve/cost_achieved_tpot_s": (False, "nullable_number"),
+    "serve/cost_decode_intensity": (False, "nullable_number"),
+    "serve/cost_verify_intensity": (False, "nullable_number"),
+    "serve/cost_decode_bound": (False, "nullable_string"),
+    "serve/cost_cards": (False, "nullable_number"),
     # per-layer numerics observatory (ISSUE 12; keys absent without a
     # NumericsConfig): groups is the fixed group count of the run's param
     # tree; per_group the nullable {group: {stat: value}} block (grad/
@@ -282,6 +310,15 @@ SERVE_SLO_FIELDS = tuple(
 #: omission (the SERVE_SLO_FIELDS discipline)
 SERVE_SPEC_FIELDS = tuple(
     f for f in SERVE_STEP_FIELDS if f.startswith("serve/spec_")
+)
+
+#: the cost/roofline subset (ISSUE 18): emitted ONLY by engines with
+#: ``ServeConfig.cost_cards`` on — the ServeCostObservatory's block is
+#: merged into the serve dict only when it exists, and
+#: ``build_step_event`` honors the omission (the SERVE_SLO_FIELDS
+#: discipline)
+SERVE_COST_FIELDS = tuple(
+    f for f in SERVE_STEP_FIELDS if f.startswith("serve/cost_")
 )
 
 #: the per-layer-numerics subset (populated via ``build_step_event``'s
@@ -551,12 +588,22 @@ def build_step_event(
         # serving fields (ISSUE 9): keys appear only when a ServingEngine
         # emits the record — a training run's JSONL never carries them
         for key in SERVE_STEP_FIELDS:
-            if key in SERVE_SLO_FIELDS and key not in serve:
+            if (
+                key in SERVE_SLO_FIELDS or key in SERVE_COST_FIELDS
+            ) and key not in serve:
                 # SLO keys ride only once a request carried a RequestSLO
-                # (ISSUE 16 default-OFF contract: zero new JSONL fields)
+                # (ISSUE 16 default-OFF contract: zero new JSONL fields);
+                # cost keys only with ServeConfig.cost_cards (ISSUE 18,
+                # same contract)
                 continue
             value = serve.get(key)
-            record[key] = None if value is None else _round(float(value))
+            if key == "serve/cost_decode_bound":
+                # the one string-kind serve field ("memory"/"compute")
+                record[key] = value
+            else:
+                record[key] = (
+                    None if value is None else _round(float(value))
+                )
         unknown = set(serve) - set(SERVE_STEP_FIELDS)
         if unknown:
             raise ValueError(

@@ -377,3 +377,41 @@ def test_emit_persisted_speculative_columns_ride_stale_emit(ledger, capsys):
     assert out["effective_tpot_s"] == 0.004
     assert out["decode_dispatches"] == 100
     assert out["decode_dispatches_baseline"] == 220
+
+
+def test_emit_persisted_cost_columns_ride_stale_emit(ledger, capsys):
+    """ISSUE 18 satellite: a re-cited serve capture carries its roofline
+    cost columns (serve_mfu / hbm_bw_util / flops_per_token /
+    attainable_tpot_s), so consumers of the stale number still see how
+    far it sat from the hardware ceiling."""
+    bench.persist_result(
+        "gpt_small_serve_throughput",
+        {"value": 1800.0, "unit": "tokens/sec", "date": "2026-08-06",
+         "backend": "tpu", "serve": True,
+         "serve_mfu": 0.032, "hbm_bw_util": 0.61,
+         "flops_per_token": 5.1e9, "attainable_tpot_s": 0.0021},
+    )
+    rc, out = _emit(capsys, "gpt_small_serve_throughput")
+    assert rc == 0
+    assert out["serve_mfu"] == 0.032
+    assert out["hbm_bw_util"] == 0.61
+    assert out["flops_per_token"] == 5.1e9
+    assert out["attainable_tpot_s"] == 0.0021
+
+
+def test_emit_persisted_cost_columns_absent_on_legacy_record(ledger, capsys):
+    """The other direction of the ISSUE 18 guard: a pre-cost (legacy)
+    serve record stays substitutable — the cost columns emit as None,
+    never invented — and the cost columns are descriptor-only: they are
+    NOT config keys, so they never block substitution either way."""
+    bench.persist_result(
+        "gpt_small_serve_throughput",
+        {"value": 1000.0, "unit": "tokens/sec", "date": "2026-07-01",
+         "backend": "tpu", "serve": True},
+    )
+    rc, out = _emit(capsys, "gpt_small_serve_throughput")
+    assert rc == 0 and out["value"] == 1000.0
+    assert out["serve_mfu"] is None
+    assert out["hbm_bw_util"] is None
+    assert out["flops_per_token"] is None
+    assert out["attainable_tpot_s"] is None

@@ -1,0 +1,485 @@
+"""Serve roofline observatory tests (ISSUE 18).
+
+The contract under test: with ``ServeConfig.cost_cards`` on, every
+serving dispatch books the analytic FLOPs/bytes of its (program, shape
+signature) cost card into the ``serve/cost/*`` counters — so the
+per-dispatch counters recombine EXACTLY into card × dispatch-count over
+a mixed trace — and the decode-family card yields a bandwidth-bound
+attainable-TPOT ceiling at the ``AttributionConfig`` peaks (steady-state
+decode classifies memory-bound; the speculative verify program's k-token
+arithmetic-intensity uplift over plain decode is measured, not assumed).
+Default-OFF discipline: an unconfigured engine constructs no
+observatory, emits zero ``serve/cost_*`` JSONL fields, and lowers HLO
+bit-identical serve programs.  The cost-drift gate compares re-lowered
+analytic cost against the committed manifest in BOTH directions.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from stoke_tpu.configs import (
+    AttributionConfig,
+    ServeConfig,
+    TelemetryConfig,
+)
+from stoke_tpu.models.gpt import GPT
+from stoke_tpu.serving import ServingEngine
+from stoke_tpu.serving.roofline import COST_FIELDS, program_bound
+from stoke_tpu.status import StokeStatus, StokeValidationError
+from stoke_tpu.utils import init_module
+
+pytestmark = [pytest.mark.serving, pytest.mark.serve_cost]
+
+VOCAB = 257
+
+#: v5e public peaks — the roofline ceilings the acceptance criteria
+#: quote (bf16 dense TFLOP/s, HBM GB/s)
+PEAK_TFLOPS = 197.0
+PEAK_HBM_GBPS = 819.0
+
+#: repetitive prompts (the test_speculative.py workload): the drafter
+#: accelerates these, so the speculative engine dispatches verify —
+#: exercising the verify-card leg of the observatory
+REP_PROMPTS = [[5, 9, 3] * 4, [11, 2] * 6, [7] * 8, [1, 2, 3] * 4]
+
+#: long repetitive prompts (32 tokens -> 2 chunks at chunk=16): force
+#: the packed-chunk program into the speculative engine's mixed trace
+LONG_PROMPTS = [
+    list(range(1, 21)) + [5, 9, 3] * 4,
+    list(range(30, 50)) + [11, 2] * 6,
+]
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    model = GPT(
+        vocab_size=VOCAB, size_name="tiny", max_len=128, dropout_rate=0.0
+    )
+    variables = init_module(
+        model, jax.random.PRNGKey(0), np.zeros((1, 8), np.int32), train=False
+    )
+    return model, variables["params"]
+
+
+def _cfg(**kw):
+    base = dict(
+        max_seqs=4, kv_block_size=8, max_seq_len=64, max_new_tokens=16,
+        prefill_pad_multiple=16,
+    )
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _attr():
+    return AttributionConfig(
+        peak_tflops=PEAK_TFLOPS, peak_hbm_gbps=PEAK_HBM_GBPS
+    )
+
+
+def _gen(eng, prompts, n):
+    rids = [eng.submit(np.asarray(p, np.int32), n) for p in prompts]
+    eng.run()
+    return [list(eng.scheduler.finished[r].tokens) for r in rids]
+
+
+def _jsonl_record(eng):
+    """The serve JSONL record exactly as emit_record builds it (without
+    attaching a full telemetry pipeline; the test_serving_slo idiom)."""
+    from stoke_tpu.telemetry.events import build_step_event
+
+    return build_step_event(
+        ts=0.0, step=1, rank=0, window_steps=1, host_dispatch_s=0.0,
+        loader_wait_s=0.0, samples_total=1.0, compiles_total=0,
+        recompiles=0, compile_time_s=0.0,
+        serve={
+            **eng.metrics.event_fields(),
+            **eng.slo.event_fields(),
+            **(eng._cost.event_fields() if eng._cost is not None else {}),
+        },
+    )
+
+
+@pytest.fixture(scope="module")
+def cost_run(gpt):
+    """ONE mixed trace through two cost-instrumented engines — a
+    speculative one (verify + packed-chunk programs) and a plain one
+    (prefill + decode) — the facets below assert against the same run
+    (engines compile once per module, the test_speculative discipline)."""
+    model, params = gpt
+    spec_eng = ServingEngine(
+        model, params,
+        _cfg(sampling=True, speculative_k=3, cost_cards=True,
+             prefill_chunk_tokens=16),
+        attribution=_attr(),
+    )
+    plain_eng = ServingEngine(
+        model, params, _cfg(cost_cards=True), attribution=_attr()
+    )
+    return {
+        "spec_eng": spec_eng,
+        "plain_eng": plain_eng,
+        "spec_out": _gen(spec_eng, LONG_PROMPTS + REP_PROMPTS[:2], 16),
+        "plain_out": _gen(plain_eng, REP_PROMPTS, 16),
+    }
+
+
+# --------------------------------------------------------------------------- #
+# exact recombination (the per-dispatch counter contract)
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("which", ["spec_eng", "plain_eng"])
+def test_counters_recombine_exactly_from_cards(cost_run, which):
+    """Over a mixed trace (prefill buckets + chunks + decode/verify),
+    sum(card × dispatches) over every (program, signature) key equals
+    the cumulative ``serve/cost/*`` counters EXACTLY — per-dispatch
+    accounting loses nothing and double-books nothing."""
+    obs = cost_run[which]._cost
+    assert obs is not None and obs.dispatch_counts
+    flops = bytes_ = 0.0
+    for key, n in obs.dispatch_counts.items():
+        card = obs.cache.cards[key]
+        flops += card.flops * n
+        bytes_ += (card.bytes_accessed or 0.0) * n
+    assert obs.flops_total() == pytest.approx(flops, rel=1e-12)
+    assert obs.bytes_total() == pytest.approx(bytes_, rel=1e-12)
+    # one card per distinct (program, signature), not per dispatch
+    assert obs.cards_total() == len(obs.dispatch_counts)
+    assert sum(obs.dispatch_counts.values()) > obs.cards_total()
+
+
+# --------------------------------------------------------------------------- #
+# roofline: bound class, attainable TPOT, verify-intensity uplift
+# --------------------------------------------------------------------------- #
+
+
+def test_decode_classifies_memory_bound(cost_run):
+    """Steady-state decode is bandwidth-bound at the v5e peaks — for the
+    plain engine's live decode card AND the speculative engine's verify
+    card (its decode-family ceiling)."""
+    assert cost_run["plain_eng"]._cost.decode_bound() == "memory"
+    assert cost_run["spec_eng"]._cost.decode_bound() == "memory"
+    card = cost_run["plain_eng"]._cost.program_cards["serve_decode"]
+    assert program_bound(card, PEAK_TFLOPS, PEAK_HBM_GBPS) == "memory"
+    # the bound flips compute at an implausibly slow-FLOP ceiling
+    assert program_bound(card, 1e-6, PEAK_HBM_GBPS) == "compute"
+    assert program_bound(None, PEAK_TFLOPS, PEAK_HBM_GBPS) is None
+
+
+def test_verify_intensity_exceeds_plain_decode(cost_run):
+    """PR 17's tokens-per-dispatch claim, measured: the k-token verify
+    program's arithmetic intensity (FLOPs/byte) beats plain decode's —
+    on the speculative engine via its lowered-only baseline card, and
+    across engines via the plain engine's live card."""
+    obs = cost_run["spec_eng"]._cost
+    assert obs.baseline_decode_card is not None  # never dispatched
+    assert "serve_decode" not in obs.program_cards
+    assert obs.verify_intensity() > obs.decode_intensity()
+    live = cost_run["plain_eng"]._cost.decode_intensity()
+    assert obs.verify_intensity() > live
+    uplift = obs.summary()["verify_intensity_uplift"]
+    assert uplift is not None and uplift > 1.0
+
+
+def test_attainable_tpot_and_gauges_populate(cost_run):
+    """The achieved-vs-attainable pair exists on CPU (attainable from
+    the analytic card at the configured peaks, achieved from the decode
+    wall) and the gauge family is published at the engine cadence."""
+    for which in ("spec_eng", "plain_eng"):
+        eng = cost_run[which]
+        obs = eng._cost
+        att, ach = obs.attainable_tpot_s(), obs.achieved_tpot_s()
+        assert att is not None and att > 0
+        assert ach is not None and ach > 0
+        assert obs.flops_per_token() > 0
+        assert obs.mfu() > 0 and obs.hbm_bw_util() > 0
+        reg = eng.metrics.registry
+        for g in ("mfu", "hbm_bw_util", "attainable_tpot_s",
+                  "achieved_tpot_s", "flops_per_token",
+                  "decode_intensity"):
+            assert reg.gauge(f"serve/cost/{g}").value > 0
+    # the attainable ceiling equals the decode-family card's roofline
+    obs = cost_run["plain_eng"]._cost
+    card = obs.program_cards["serve_decode"]
+    expect = max(
+        card.flops / (PEAK_TFLOPS * 1e12),
+        card.bytes_accessed / (PEAK_HBM_GBPS * 1e9),
+    )
+    assert obs.attainable_tpot_s() == pytest.approx(expect, rel=1e-12)
+
+
+def test_slo_tracker_gains_tflop_goodput(cost_run):
+    """The cost observatory arms the SLO tracker's per-token cost at the
+    gauge cadence; TFLOP-goodput is per-token cost × token goodput."""
+    eng = cost_run["plain_eng"]
+    assert eng.slo._flops_per_token == eng._cost.flops_per_token()
+    # the tracker itself converts only when SLO-tagged requests exist —
+    # the arithmetic is the contract here
+    tf = eng.slo.goodput_tflops_per_s()
+    gp = eng.slo.goodput_tokens_per_s()
+    if gp is None:
+        assert tf is None
+    else:
+        assert tf == pytest.approx(
+            gp * eng._cost.flops_per_token() / 1e12
+        )
+
+
+# --------------------------------------------------------------------------- #
+# JSONL block + summary
+# --------------------------------------------------------------------------- #
+
+
+def test_event_fields_cover_the_pinned_wire_block(cost_run):
+    """``event_fields`` emits exactly the COST_FIELDS block — which is
+    itself pinned append-only in wire_formats.json."""
+    fields = cost_run["spec_eng"]._cost.event_fields()
+    assert set(fields) == set(COST_FIELDS)
+    assert fields["serve/cost_decode_bound"] == "memory"
+    assert fields["serve/cost_flops"] > 0
+    assert fields["serve/cost_cards"] == float(
+        cost_run["spec_eng"]._cost.cards_total()
+    )
+    manifest = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "stoke_tpu", "analysis", "manifests", "wire_formats.json",
+    )
+    with open(manifest) as f:
+        pinned = [
+            e for e in json.load(f)["wire_formats"]
+            if e["name"] == "COST_FIELDS"
+        ]
+    assert len(pinned) == 1
+    assert tuple(pinned[0]["fields"]) == COST_FIELDS
+
+
+def test_emit_record_and_summary_carry_cost_block(cost_run):
+    rec = _jsonl_record(cost_run["plain_eng"])
+    for k in COST_FIELDS:
+        assert k in rec
+    assert rec["serve/cost_decode_bound"] == "memory"
+    assert rec["serve/cost_flops"] > 0
+    s = cost_run["plain_eng"].summary()["cost"]
+    assert s["active"] is True
+    assert s["peak_tflops"] == PEAK_TFLOPS
+    assert s["decode_bound"] == "memory"
+    assert set(s["cards"]) == {
+        p for (p, _sig) in cost_run["plain_eng"]._cost.dispatch_counts
+    }
+    card = s["cards"]["serve_decode"]
+    assert card["flops"] > 0 and card["intensity"] > 0
+
+
+# --------------------------------------------------------------------------- #
+# default-OFF: no observatory, no fields, bit-identical programs
+# --------------------------------------------------------------------------- #
+
+
+def test_default_off_engine_is_cost_free(gpt):
+    model, params = gpt
+    eng = ServingEngine(model, params, _cfg())
+    assert eng._cost is None
+    assert eng.metrics.cost_active is False
+    assert eng.summary()["cost"] == {"active": False}
+    _gen(eng, REP_PROMPTS[:2], 4)
+    rec = _jsonl_record(eng)
+    assert rec is not None
+    assert not any(k.startswith("serve/cost") for k in rec)
+
+
+def test_default_off_decode_program_lowers_bit_identical(gpt):
+    """cost_cards is host-side bookkeeping only: fresh engines with and
+    without it lower the SAME decode HLO (the audit_specs discipline —
+    fresh engines, because a run engine's cache arrays carry dispatch
+    sharding annotations that differ textually)."""
+    model, params = gpt
+    eng_off = ServingEngine(model, params, _cfg())
+    eng_on = ServingEngine(
+        model, params, _cfg(cost_cards=True), attribution=_attr()
+    )
+
+    def decode_hlo(eng):
+        return jax.jit(eng._decode_jit).lower(
+            *eng._decode_baseline_args()
+        ).as_text()
+
+    assert decode_hlo(eng_off) == decode_hlo(eng_on)
+
+
+# --------------------------------------------------------------------------- #
+# validation
+# --------------------------------------------------------------------------- #
+
+
+def test_engine_requires_attribution_peaks(gpt):
+    model, params = gpt
+    with pytest.raises(ValueError, match="cost_cards"):
+        ServingEngine(model, params, _cfg(cost_cards=True))
+
+
+def test_status_rules(tmp_path):
+    serve = _cfg(cost_cards=True)
+    tcfg = TelemetryConfig(output_dir=str(tmp_path / "t"), prometheus=False)
+    with pytest.raises(
+        StokeValidationError, match="requires an\\s+AttributionConfig"
+    ):
+        StokeStatus(batch_size_per_device=1, configs=[serve])
+    with pytest.raises(StokeValidationError, match="peak_hbm_gbps"):
+        StokeStatus(
+            batch_size_per_device=1,
+            configs=[
+                serve, tcfg, AttributionConfig(peak_tflops=PEAK_TFLOPS)
+            ],
+        )
+    # the valid combination passes
+    StokeStatus(
+        batch_size_per_device=1, configs=[serve, tcfg, _attr()]
+    )
+
+
+# --------------------------------------------------------------------------- #
+# cost-drift gate
+# --------------------------------------------------------------------------- #
+
+
+def _serve_specs(cost_run):
+    return [
+        s for s in cost_run["plain_eng"].audit_specs()
+        if s.source == "serve"
+    ]
+
+
+def _manifest_for(specs):
+    from stoke_tpu.analysis.program import spec_cost_entry
+
+    programs = {}
+    for s in specs:
+        if s.program in programs:
+            continue
+        entry = spec_cost_entry(s)
+        if entry is not None:
+            programs[s.program] = entry
+    return {"tolerance": 0.05, "programs": programs}
+
+
+def _drift_findings(rep):
+    return [f for f in rep.findings if f.rule == "audit-cost-drift"]
+
+
+def test_cost_drift_gate_clean_manifest_passes(cost_run):
+    from stoke_tpu.analysis.program import audit_program_specs
+
+    specs = _serve_specs(cost_run)
+    assert specs
+    rep = audit_program_specs(specs, cost_manifest=_manifest_for(specs))
+    assert _drift_findings(rep) == []
+
+
+def test_cost_drift_gate_fires_both_directions(cost_run):
+    from stoke_tpu.analysis.program import audit_program_specs
+
+    specs = _serve_specs(cost_run)
+    bloat = _manifest_for(specs)
+    prog = specs[0].program
+    bloat["programs"][prog]["flops"] *= 1.5  # pinned ABOVE measured
+    rep = audit_program_specs(specs, cost_manifest=bloat)
+    (f,) = _drift_findings(rep)
+    assert prog in f.message and "shrank" in f.message
+
+    slim = _manifest_for(specs)
+    slim["programs"][prog]["flops"] /= 1.5  # pinned BELOW measured
+    rep = audit_program_specs(specs, cost_manifest=slim)
+    (f,) = _drift_findings(rep)
+    assert "grew" in f.message
+    # a widened tolerance swallows the same deviation
+    rep = audit_program_specs(
+        specs, cost_manifest=slim, cost_tolerance=0.6
+    )
+    assert _drift_findings(rep) == []
+
+
+def test_cost_drift_gate_unpinned_and_sig_mismatch(cost_run):
+    from stoke_tpu.analysis.program import audit_program_specs
+
+    specs = _serve_specs(cost_run)
+    manifest = _manifest_for(specs)
+    prog = specs[0].program
+    # an unpinned serve program is a finding (the gate must not silently
+    # skip new programs)
+    del manifest["programs"][prog]
+    rep = audit_program_specs(specs, cost_manifest=manifest)
+    (f,) = _drift_findings(rep)
+    assert prog in f.message and "update-costs" in f.remedy
+    # a geometry-signature mismatch is NOT comparable → note, no finding
+    manifest = _manifest_for(specs)
+    manifest["programs"][prog]["sig"] = "0" * 16
+    manifest["programs"][prog]["flops"] *= 100.0
+    rep = audit_program_specs(specs, cost_manifest=manifest)
+    assert _drift_findings(rep) == []
+    assert any("signature" in n for n in rep.notes)
+    # no manifest at all → the gate notes itself unchecked
+    rep = audit_program_specs(specs)
+    assert _drift_findings(rep) == []
+    assert any("no program-cost manifest" in n for n in rep.notes)
+
+
+@pytest.mark.slow
+def test_stoke_lint_programs_cli_drift_fixture(tmp_path):
+    """The CI gate end-to-end: ``stoke_lint.py --programs`` against a
+    doctored manifest (one program's pinned FLOPs bloated 1.5x) exits 1
+    with the audit-cost-drift finding printed; against the committed
+    manifest the tree passes clean."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    committed = os.path.join(
+        repo, "stoke_tpu", "analysis", "manifests", "program_costs.json"
+    )
+    with open(committed) as f:
+        manifest = json.load(f)
+    manifest["programs"]["serve_decode"]["flops"] *= 1.5
+    doctored = tmp_path / "doctored_costs.json"
+    doctored.write_text(json.dumps(manifest))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "stoke_lint.py"),
+         "--programs", "--cost-manifest", str(doctored)],
+        capture_output=True, text=True, cwd=repo, env=env, timeout=600,
+    )
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "audit-cost-drift" in out.stdout
+    assert "serve_decode" in out.stdout and "shrank" in out.stdout
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "stoke_lint.py"),
+         "--programs"],
+        capture_output=True, text=True, cwd=repo, env=env, timeout=600,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "0 finding(s)" in out.stdout
+
+
+def test_committed_manifest_matches_lint_worker_geometry():
+    """The committed program_costs.json pins all five serve program
+    families with positive analytic numbers and the regeneration remedy
+    in its comment block."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "stoke_tpu", "analysis", "manifests", "program_costs.json",
+    )
+    with open(path) as f:
+        manifest = json.load(f)
+    assert set(manifest["programs"]) == {
+        "serve_prefill", "serve_prefill_chunk",
+        "serve_prefill_chunk_packed", "serve_decode", "serve_verify",
+    }
+    assert manifest["tolerance"] == 0.05
+    for entry in manifest["programs"].values():
+        assert entry["flops"] > 0
+        assert entry["bytes_accessed"] > 0
+        assert len(entry["sig"]) == 16
+    assert "--update-costs" in " ".join(manifest["_comment"])

@@ -1181,10 +1181,12 @@ def test_serve_event_fields_match_schema():
     both.  The serve/slo_* fields (ISSUE 16) are the schema's nullable
     tail: SLOTracker emits them only once a deadline-tagged request
     exists — and the serve/spec_* fields (ISSUE 17) likewise appear only
-    on a speculative engine — so a plain ServeMetrics covers exactly the
-    non-SLO non-speculative slice, and enable_speculative() grows the
-    block by exactly SERVE_SPEC_FIELDS."""
+    on a speculative engine, and the serve/cost_* block (ISSUE 18) only
+    on a cost-instrumented one — so a plain ServeMetrics covers exactly
+    the non-SLO non-speculative non-cost slice, and enable_speculative()
+    grows the block by exactly SERVE_SPEC_FIELDS."""
     from stoke_tpu.telemetry.events import (
+        SERVE_COST_FIELDS,
         SERVE_SLO_FIELDS,
         SERVE_SPEC_FIELDS,
         SERVE_STEP_FIELDS,
@@ -1199,6 +1201,7 @@ def test_serve_event_fields_match_schema():
         set(SERVE_STEP_FIELDS)
         - set(SERVE_SLO_FIELDS)
         - set(SERVE_SPEC_FIELDS)
+        - set(SERVE_COST_FIELDS)
     )
     assert "serve/prefill_chunks" in fields
     assert "serve/sampled_tokens" in fields

@@ -366,6 +366,13 @@ def test_slo_event_fields_round_trip_the_jsonl_schema():
     t.on_admit(req)
     t.on_finish(req, spans=[], dropped=0)
     fields = t.event_fields()
+    # the TFLOP-goodput column (ISSUE 18) rides only when the cost
+    # observatory armed a per-token cost
+    assert set(fields) == (
+        set(SERVE_SLO_FIELDS) - {"serve/slo_goodput_tflops_per_s"}
+    )
+    t.set_flops_per_token(2.0e9)
+    fields = t.event_fields()
     assert set(fields) == set(SERVE_SLO_FIELDS)
     base = dict(
         ts=0.0, step=1, rank=0, window_steps=1, host_dispatch_s=0.0,
