@@ -127,6 +127,9 @@ _SERVE_KEY_DEFAULTS = {
     # pre-ISSUE-17 serve records were all non-speculative single-token
     # decode captures
     "serve_speculative": False,
+    # pre-ISSUE-19 records (train AND serve — the key is shared) carried
+    # no HBM capacity ledger
+    "memory": False,
 }
 
 
@@ -219,6 +222,8 @@ def _emit_persisted(metric: str, capture_error: str,
                         "quant_err_max", "quant_err_layer",
                         "serve_mfu", "hbm_bw_util", "flops_per_token",
                         "attainable_tpot_s",
+                        "memory", "mem_resident_bytes",
+                        "mem_temp_peak_bytes", "mem_headroom_frac",
                     )
                 }
                 if rec.get("serve")
@@ -256,7 +261,7 @@ REGRESSION_TOLERANCE = 0.05
 _REGRESSION_CONFIG_KEYS = (
     "xla_flags", "steps_per_dispatch", "comm_dtype", "comm_shard_tier",
     "health", "attribution", "fleet", "tuned", "resilience", "trace",
-    "numerics", "serve", "serve_quant", "serve_max_seqs",
+    "numerics", "memory", "serve", "serve_quant", "serve_max_seqs",
     "serve_decode_kernel", "serve_prefill_chunk", "serve_sampling",
     "serve_long_prompt", "serve_priority_mix", "serve_speculative",
 )
@@ -534,7 +539,11 @@ def _serve_bench(args, tiny: bool) -> int:
 
     import jax
 
-    from stoke_tpu.configs import AttributionConfig, ServeConfig
+    from stoke_tpu.configs import (
+        AttributionConfig,
+        MemoryConfig,
+        ServeConfig,
+    )
     from stoke_tpu.models.gpt import GPT
     from stoke_tpu.serving import RequestSLO, ServingEngine
     from stoke_tpu.utils import init_module
@@ -604,7 +613,12 @@ def _serve_bench(args, tiny: bool) -> int:
         )
         return (
             ServingEngine(
-                model, variables["params"], cfg, attribution=attribution
+                model, variables["params"], cfg, attribution=attribution,
+                # memory arm (ISSUE 19): the engine's own HBM ledger
+                # (quantized weight store + KV block pool) plus the
+                # per-program memory_analysis peaks and the KV headroom
+                # forecast — host-side bookkeeping, programs unchanged
+                memory=MemoryConfig() if args.memory else None,
             ),
             cfg,
         )
@@ -786,6 +800,24 @@ def _serve_bench(args, tiny: bool) -> int:
         ),
     }
 
+    # memory columns (ISSUE 19): the serving engine's analytic resident
+    # ledger and the capacity fraction still free after the predicted
+    # peak (None off-accelerator — no capacity to fraction against)
+    mem_cols = {}
+    if args.memory:
+        ms = eng.summary()["memory"]
+        _cap = ms.get("capacity_bytes")
+        _head = ms.get("headroom_bytes")
+        mem_cols = {
+            "memory": True,
+            "mem_resident_bytes": ms.get("resident_bytes"),
+            "mem_temp_peak_bytes": ms.get("temp_peak_bytes"),
+            "mem_headroom_frac": (
+                None if not _cap or _head is None
+                else round(_head / _cap, 4)
+            ),
+        }
+
     stall_unchunked = None
     if long_arm:
         # the comparison leg: same trace, chunking disabled — its stall
@@ -825,6 +857,7 @@ def _serve_bench(args, tiny: bool) -> int:
         **spec_cols,
         **slo_cols,
         **cost_cols,
+        **mem_cols,
         "requests": n,
         "ttft_p50_s": round(pct["ttft_p50_s"], 6),
         "ttft_p99_s": round(pct["ttft_p99_s"], 6),
@@ -858,6 +891,7 @@ def _serve_bench(args, tiny: bool) -> int:
                 "serve_long_prompt": True if long_arm else None,
                 "serve_priority_mix": True if mix else None,
                 "serve_speculative": True if spec else None,
+                "memory": True if args.memory else None,
             },
         )
         if regression is not None:
@@ -903,6 +937,7 @@ def _serve_bench(args, tiny: bool) -> int:
                 **spec_cols,
                 **slo_cols,
                 **cost_cols,
+                **mem_cols,
                 "requests": n,
                 "ttft_p50_s": result["ttft_p50_s"],
                 "ttft_p99_s": result["ttft_p99_s"],
@@ -1026,6 +1061,21 @@ def main():
                     "drift) and numerics_overhead_frac / "
                     "numerics_overhead_ok (< 2%%) record the verdict.  A "
                     "distinct configuration for the stale-substitution "
+                    "and regression guards")
+    ap.add_argument("--memory", action="store_true",
+                    help="HBM capacity-ledger arm (ISSUE 19): the "
+                    "measured run carries the analytic per-subsystem "
+                    "memory observatory — params/optimizer/transport/"
+                    "snapshot resident ledger, per-program "
+                    "memory_analysis peaks, OOM pre-flight — and the "
+                    "capture records mem_resident_bytes / "
+                    "mem_temp_peak_bytes / mem_headroom_frac columns; "
+                    "with --serve the engine's ledger (quantized weight "
+                    "store + KV block pool) and headroom forecast ride "
+                    "the serve capture instead.  Host-side arithmetic "
+                    "plus one memory_analysis compile per program "
+                    "signature; the dispatched programs are unchanged.  "
+                    "A distinct configuration for the stale-substitution "
                     "and regression guards")
     ap.add_argument("--resilience", action="store_true",
                     help="enable pod-scale resilience (ISSUE 7) on the "
@@ -1214,6 +1264,11 @@ def main():
                 "resilience": True if args.resilience else None,
                 "trace": True if args.trace else None,
                 "numerics": True if args.numerics else None,
+                # memory wants are ALWAYS explicit (the _SERVE_KEY_DEFAULTS
+                # rule, applied to a train+serve key): absent ledger keys
+                # normalize to False, so a default run never cites a
+                # --memory capture and vice versa
+                "memory": bool(args.memory),
                 "attribution": (
                     True if args.attribution_peak_tflops else None
                 ),
@@ -1318,7 +1373,7 @@ def main():
             shard_updates=True if shard_tier == "oss" else None,
         ))
     if (args.health or args.attribution_peak_tflops or args.fleet
-            or args.numerics):
+            or args.numerics or args.memory):
         # health (ISSUE 3) / attribution (ISSUE 4) / fleet (ISSUE 5) arms
         # all ride the telemetry pipeline (status-validated requirement)
         # — JSONL only, quiet cadence, no device-time sampling, so the
@@ -1343,6 +1398,14 @@ def main():
         from stoke_tpu import NumericsConfig
 
         run_configs.append(NumericsConfig())
+    if args.memory:
+        # memory arm (ISSUE 19): the analytic HBM ledger + per-program
+        # memory_analysis peaks observe the measured run — host-side
+        # arithmetic over trees the run already holds; the step programs
+        # themselves are untouched
+        from stoke_tpu import MemoryConfig
+
+        run_configs.append(MemoryConfig())
     if args.attribution_peak_tflops:
         # attribution arm (ISSUE 4): CostCards + live MFU + goodput
         # ledger observe the measured run; the ledger descriptor records
@@ -1713,6 +1776,20 @@ def main():
                 f"(claim is < 2%)",
                 file=sys.stderr,
             )
+    if args.memory:
+        # memory columns (ISSUE 19): what this capture kept resident,
+        # the worst program transient, and the capacity fraction still
+        # free after the predicted peak (None off-accelerator — the CPU
+        # simulator reports no capacity)
+        ms = stoke.memory_summary or {}
+        _cap = ms.get("capacity_bytes")
+        _head = ms.get("headroom_bytes")
+        result["memory"] = True
+        result["mem_resident_bytes"] = ms.get("resident_bytes")
+        result["mem_temp_peak_bytes"] = ms.get("temp_peak_bytes")
+        result["mem_headroom_frac"] = (
+            None if not _cap or _head is None else round(_head / _cap, 4)
+        )
     if args.resilience:
         # resilience columns (ISSUE 7): the restart/resume accounting of
         # the measured run — quiet here (nothing preempts a bench), but
@@ -1826,7 +1903,8 @@ def main():
         result["cache_miss"] = cc.misses
         result["cache_saved_compile_s"] = round(cc.saved_compile_s, 3)
     if (args.health or args.attribution_peak_tflops or args.fleet
-            or args.resilience or args.trace or args.numerics):
+            or args.resilience or args.trace or args.numerics
+            or args.memory):
         stoke.close_telemetry()
     if on_accel:
         regression = check_regression(
@@ -1846,6 +1924,7 @@ def main():
                 "resilience": True if args.resilience else None,
                 "trace": True if args.trace else None,
                 "numerics": True if args.numerics else None,
+                "memory": True if args.memory else None,
             },
         )
         if regression is not None:
@@ -1950,6 +2029,18 @@ def main():
                         ],
                     }
                     if args.numerics
+                    else {}
+                ),
+                **(
+                    {
+                        "memory": True,
+                        "mem_resident_bytes": result["mem_resident_bytes"],
+                        "mem_temp_peak_bytes": result[
+                            "mem_temp_peak_bytes"
+                        ],
+                        "mem_headroom_frac": result["mem_headroom_frac"],
+                    }
+                    if args.memory
                     else {}
                 ),
                 **(

@@ -136,23 +136,36 @@ chunk_eng.run()
 
 # cost-drift gate (ISSUE 18): the committed analytic-cost manifest rides
 # in via STOKE_COST_MANIFEST; the worker also reports every serve spec's
-# measured cost so --update-costs can re-pin the manifest
+# measured cost so --update-costs can re-pin the manifest.  The
+# memory-drift gate (ISSUE 19) mirrors it via STOKE_MEM_MANIFEST /
+# --update-mem over memory_analysis temp/peak bytes.
 import os
 cost_manifest = None
 manifest_path = os.environ.get("STOKE_COST_MANIFEST")
 if manifest_path:
     with open(manifest_path) as fh:
         cost_manifest = json.load(fh)
+mem_manifest = None
+mem_path = os.environ.get("STOKE_MEM_MANIFEST")
+if mem_path:
+    with open(mem_path) as fh:
+        mem_manifest = json.load(fh)
 
-from stoke_tpu.analysis.program import audit_program_specs, spec_cost_entry
+from stoke_tpu.analysis.program import (
+    audit_program_specs,
+    spec_cost_entry,
+    spec_memory_entry,
+)
 
 findings = []
 programs = []
 notes = []
 costs = {}
+mems = {}
 for st, serve_eng in ((s, eng), (s2, spec_eng)):
     before = st.dispatch_count
-    rep = st.audit(serve=serve_eng, cost_manifest=cost_manifest)
+    rep = st.audit(serve=serve_eng, cost_manifest=cost_manifest,
+                   mem_manifest=mem_manifest)
     assert st.dispatch_count == before, "audit dispatched a program"
     findings += [f.to_dict() for f in rep.findings]
     programs += rep.programs
@@ -160,7 +173,8 @@ for st, serve_eng in ((s, eng), (s2, spec_eng)):
 # the chunked engine rides a standalone serve-spec audit (its step-side
 # twin is already covered above)
 rep = audit_program_specs(chunk_eng.audit_specs(),
-                          cost_manifest=cost_manifest)
+                          cost_manifest=cost_manifest,
+                          mem_manifest=mem_manifest)
 findings += [f.to_dict() for f in rep.findings]
 programs += rep.programs
 # engines share programs (serve_decode is dispatched by two of them) —
@@ -174,13 +188,16 @@ for f in findings:
 findings = deduped
 for serve_eng in (eng, spec_eng, chunk_eng):
     for spec in serve_eng.audit_specs():
-        if spec.program in costs:
-            continue
-        entry = spec_cost_entry(spec)
-        if entry is not None:
-            costs[spec.program] = entry
+        if spec.program not in costs:
+            entry = spec_cost_entry(spec)
+            if entry is not None:
+                costs[spec.program] = entry
+        if spec.program not in mems:
+            entry = spec_memory_entry(spec)
+            if entry is not None:
+                mems[spec.program] = entry
 print(json.dumps({"programs": programs, "findings": findings,
-                  "notes": notes, "costs": costs}))
+                  "notes": notes, "costs": costs, "mems": mems}))
 """
 
 #: the committed analytic-cost manifest the drift gate compares against
@@ -188,14 +205,23 @@ _COST_MANIFEST = os.path.join(
     "stoke_tpu", "analysis", "manifests", "program_costs.json"
 )
 
+#: the committed program-memory manifest (ISSUE 19) the memory-drift
+#: gate compares against
+_MEM_MANIFEST = os.path.join(
+    "stoke_tpu", "analysis", "manifests", "program_memory.json"
+)
+
 
 def run_program_audit(
-    repo_root: str, cost_manifest_path: str | None = None
+    repo_root: str,
+    cost_manifest_path: str | None = None,
+    mem_manifest_path: str | None = None,
 ) -> dict:
     """Spawn the jax-side program audit with a pinned CPU environment;
     returns the worker's JSON payload.  ``cost_manifest_path`` arms the
-    audit-cost-drift gate (defaults to the committed manifest when it
-    exists; pass "" to disarm)."""
+    audit-cost-drift gate and ``mem_manifest_path`` the
+    audit-memory-drift gate (each defaults to its committed manifest
+    when it exists; pass "" to disarm)."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
@@ -207,6 +233,13 @@ def run_program_audit(
         env["STOKE_COST_MANIFEST"] = os.path.abspath(cost_manifest_path)
     else:
         env.pop("STOKE_COST_MANIFEST", None)
+    if mem_manifest_path is None:
+        default = os.path.join(repo_root, _MEM_MANIFEST)
+        mem_manifest_path = default if os.path.exists(default) else ""
+    if mem_manifest_path:
+        env["STOKE_MEM_MANIFEST"] = os.path.abspath(mem_manifest_path)
+    else:
+        env.pop("STOKE_MEM_MANIFEST", None)
     proc = subprocess.run(
         [sys.executable, "-c", _PROGRAM_WORKER],
         capture_output=True,
@@ -257,6 +290,22 @@ def main(argv=None) -> int:
         "manifest from the live engines' measured analytic costs "
         "(run after an INTENTIONAL serve-program cost change)",
     )
+    ap.add_argument(
+        "--mem-manifest",
+        default=None,
+        metavar="PATH",
+        help="program-memory manifest for the audit-memory-drift gate "
+        "(default: the committed "
+        "stoke_tpu/analysis/manifests/program_memory.json; pass an "
+        "empty string to disarm)",
+    )
+    ap.add_argument(
+        "--update-mem",
+        action="store_true",
+        help="with --programs: rewrite the committed program-memory "
+        "manifest from the live engines' measured memory_analysis "
+        "temp/peak bytes (run after an INTENTIONAL footprint change)",
+    )
     args = ap.parse_args(argv)
     repo_root = os.path.abspath(args.repo_root)
     if not os.path.isdir(repo_root):
@@ -265,6 +314,10 @@ def main(argv=None) -> int:
 
     if args.update_costs and not args.programs:
         print("stoke_lint: --update-costs requires --programs",
+              file=sys.stderr)
+        return 2
+    if args.update_mem and not args.programs:
+        print("stoke_lint: --update-mem requires --programs",
               file=sys.stderr)
         return 2
 
@@ -279,6 +332,8 @@ def main(argv=None) -> int:
                 # stale pins it is about to replace
                 cost_manifest_path="" if args.update_costs
                 else args.cost_manifest,
+                mem_manifest_path="" if args.update_mem
+                else args.mem_manifest,
             )
         except Exception as e:
             print(f"stoke_lint: {e}", file=sys.stderr)
@@ -307,6 +362,31 @@ def main(argv=None) -> int:
             print(
                 f"stoke_lint: pinned {len(manifest['programs'])} "
                 f"program cost(s) -> {manifest_path}"
+            )
+        if args.update_mem:
+            manifest_path = os.path.join(repo_root, _MEM_MANIFEST)
+            manifest = {
+                "_comment": [
+                    "ISSUE 19 program-memory manifest: the",
+                    "audit-memory-drift gate re-compiles every serve",
+                    "program and compares its memory_analysis temp/peak",
+                    "bytes against these pins at matching argument-",
+                    "geometry signature.  Deviations beyond the tolerance",
+                    "fail CI in BOTH directions (golden-file semantics;",
+                    "looser than the cost gate — XLA temp allocation",
+                    "shifts more across versions than analytic FLOPs).",
+                    "Regenerate after an INTENTIONAL footprint change:",
+                    "  python scripts/stoke_lint.py --programs --update-mem",
+                ],
+                "tolerance": 0.25,
+                "programs": dict(sorted(payload["mems"].items())),
+            }
+            with open(manifest_path, "w") as fh:
+                json.dump(manifest, fh, indent=2)
+                fh.write("\n")
+            print(
+                f"stoke_lint: pinned {len(manifest['programs'])} "
+                f"program memory entr(y/ies) -> {manifest_path}"
             )
 
     if args.json:

@@ -29,8 +29,15 @@ end-to-end latency); since ISSUE 17, the serve cycle runs speculative
 (``speculative_k=3``) with one repetitive-prompt request the
 prompt-lookup drafter accelerates — accept-rate > 0 asserted on the
 serve/spec_* counters, and the greedy streams asserted BIT-IDENTICAL to
-a non-speculative reference engine.  Prints the step record and a
-one-line verdict; exit 0 only when everything round-trips.
+a non-speculative reference engine; since ISSUE 19, the train window and
+the serve cycle both run memory-armed (``MemoryConfig``) — the ``mem/*``
+JSONL ledger block asserted to recombine exactly (components sum to the
+resident total), the serve record carrying the KV headroom forecast and
+the engine-side ledger (quantized weight store + KV block pool), the
+ledger gauges in the Prometheus exposition, and every NON-armed run's
+records asserted memory-free (the default-OFF contract).  Prints the
+step record and a one-line verdict; exit 0 only when everything
+round-trips.
 """
 
 from __future__ import annotations
@@ -74,6 +81,7 @@ def run_serve_cycle(sv_dir: str) -> dict:
 
     from stoke_tpu import (
         AttributionConfig,
+        MemoryConfig,
         ServeConfig,
         Stoke,
         StokeOptimizer,
@@ -125,6 +133,11 @@ def run_serve_cycle(sv_dir: str) -> dict:
                 cost_cards=True,
             ),
             AttributionConfig(peak_tflops=197.0, peak_hbm_gbps=819.0),
+            # ISSUE 19: the serve-side HBM ledger — the engine registers
+            # its quantized weight store + KV block pool, the serve
+            # records carry the mem/* block and the KV headroom
+            # forecast, and the recombination identity is asserted below
+            MemoryConfig(),
             # traced serve requests (ISSUE 10/13): the per-request
             # admission -> [chunks] -> prefill -> decode timelines are
             # parsed below
@@ -229,6 +242,33 @@ def run_serve_cycle(sv_dir: str) -> dict:
         and (cost_summary.get("verify_intensity_uplift") or 0.0) > 1.0
         and ref_eng.summary().get("cost", {}).get("active") is False
     )
+    # ISSUE 19: the serve-side HBM ledger — the engine's two components
+    # (quantized weight store + KV block pool) recombine EXACTLY into
+    # the resident total on the JSONL record, the train-only components
+    # stay None (absent, not zero), per-program memory_analysis peaks
+    # were captured at the dispatch funnel, the KV headroom forecast
+    # rides the serve block (every block back in the pool => the full
+    # free-pool bytes), the ledger gauges reach the exposition, and the
+    # memory-free reference engine's summary block stays inactive (the
+    # default-OFF contract, engine-side)
+    mem_summary = sv_eng.summary().get("memory", {})
+    mem_ok = (
+        (sv_rec.get("mem/params_bytes") or 0) > 0
+        and (sv_rec.get("mem/kv_cache_bytes") or 0) > 0
+        and sv_rec.get("mem/params_bytes")
+        + sv_rec.get("mem/kv_cache_bytes")
+        == sv_rec.get("mem/resident_bytes")
+        and sv_rec.get("mem/opt_state_bytes") is None
+        and sv_rec.get("mem/transport_bytes") is None
+        and (sv_rec.get("mem/temp_peak_bytes") or 0) > 0
+        and (sv_rec.get("serve/mem_headroom_bytes") or 0) > 0
+        and "stoke_mem_resident_bytes" in sv_prom
+        and "stoke_serve_mem_headroom_bytes" in sv_prom
+        and mem_summary.get("active") is True
+        and bool(mem_summary.get("programs"))
+        and "serve" in mem_summary.get("preflights", {})
+        and ref_eng.summary().get("memory", {}).get("active") is False
+    )
     ok = (
         all(
             len(sv_eng.scheduler.finished[rid].tokens) == 4
@@ -273,9 +313,13 @@ def run_serve_cycle(sv_dir: str) -> dict:
         # ISSUE 18: cost-card / roofline wire evidence
         and cost_ok
         and "stoke_serve_cost_flops_total" in sv_prom
+        # ISSUE 19: HBM-ledger wire evidence
+        and mem_ok
     )
     return {
         "ok": ok,
+        "mem_ok": mem_ok,
+        "mem_summary": mem_summary,
         "cost_summary": cost_summary,
         "spec_drafted": spec_drafted,
         "spec_accepted": spec_accepted,
@@ -307,6 +351,7 @@ def main() -> int:
         AttributionConfig,
         FleetConfig,
         HealthConfig,
+        MemoryConfig,
         NumericsConfig,
         Stoke,
         StokeOptimizer,
@@ -341,6 +386,10 @@ def main() -> int:
     # per-layer numerics (ISSUE 12): the group-stats matrix rides the
     # same compiled step; the per-group block is asserted on the record
     nmcfg = NumericsConfig()
+    # HBM capacity ledger (ISSUE 19): the analytic per-subsystem
+    # observatory rides the same window — the mem/* JSONL block, the
+    # recombination identity, and the ledger gauges are asserted below
+    mmcfg = MemoryConfig()
     stoke = Stoke(
         model=lambda p, x: x @ p["w"],
         optimizer=StokeOptimizer(
@@ -349,7 +398,7 @@ def main() -> int:
         loss=lambda o, y: ((o - y) ** 2).mean(),
         params={"w": np.ones((8, 4), np.float32)},
         batch_size_per_device=16,
-        configs=[cfg, hcfg, acfg, fcfg, trcfg, nmcfg],
+        configs=[cfg, hcfg, acfg, fcfg, trcfg, nmcfg, mmcfg],
         verbose=False,
     )
     x = np.ones((16, 8), np.float32)
@@ -721,6 +770,34 @@ def main() -> int:
         if f.startswith("events.out.tfevents.")
     ]
     tb_events = read_scalar_events(tb_files[0]) if tb_files else []
+    # ISSUE 19: the train-side HBM ledger — the four train components
+    # recombine EXACTLY into the resident total (kv_cache stays None:
+    # no serving subsystem in this run), the worst program transient
+    # was captured at the dispatch funnel, predicted peak = resident +
+    # transient, the build pre-flight was recorded (silent — the CPU
+    # simulator reports no capacity to squeeze against), and the
+    # resident gauge reached the exposition
+    mem_preflights = stoke.memory.preflights if stoke.memory else {}
+    memory_ok = (
+        rec.get("mem/params_bytes") == 128
+        and None not in (
+            rec.get("mem/opt_state_bytes"),
+            rec.get("mem/transport_bytes"),
+            rec.get("mem/snapshot_bytes"),
+        )
+        and rec.get("mem/params_bytes")
+        + rec.get("mem/opt_state_bytes")
+        + rec.get("mem/transport_bytes")
+        + rec.get("mem/snapshot_bytes")
+        == rec.get("mem/resident_bytes")
+        and rec.get("mem/kv_cache_bytes") is None
+        and (rec.get("mem/temp_peak_bytes") or 0) > 0
+        and rec.get("mem/predicted_peak_bytes")
+        == rec.get("mem/resident_bytes") + rec.get("mem/temp_peak_bytes")
+        and "build" in mem_preflights
+        and mem_preflights["build"]["fired"] is False
+        and "stoke_mem_resident_bytes" in prom
+    )
     ok = (
         len(records) == 2
         and records[0]["step"] == 1
@@ -744,6 +821,7 @@ def main() -> int:
         and serving_ok
         and tracing_ok
         and numerics_ok
+        and memory_ok
         # ISSUE 12: the main run's record carries the per-group block
         # (one group: the single "w" param)
         and (rec.get("numerics/per_group") or {}).keys() == {"w"}
@@ -755,6 +833,12 @@ def main() -> int:
         and not any(k.startswith("serve/") for k in rec)
         and not any(k.startswith("serve/cost_") for r in records for k in r)
         and not any(k.startswith("numerics/") for k in sv_rec)
+        # ISSUE 19 default-OFF discipline: runs WITHOUT a MemoryConfig
+        # (the sharded-transport and numerics legs) emit records with
+        # zero mem/* fields — absent, never null
+        and not any(k.startswith("mem/") for k in zero_rec)
+        and not any(k.startswith("mem/") for k in nm_rec)
+        and not any(k.startswith("mem/") for k in nm_clean_rec)
     )
     print(json.dumps({
         "telemetry_smoke": "ok" if ok else "FAILED",
@@ -798,6 +882,15 @@ def main() -> int:
         "numerics": "ok" if numerics_ok else "FAILED",
         "numerics_provenance": nm_rec.get("numerics/provenance_name"),
         "numerics_diff_aligned": diff_report.get("aligned_steps"),
+        "memory": "ok" if memory_ok else "FAILED",
+        "mem_resident_bytes": rec.get("mem/resident_bytes"),
+        "mem_temp_peak_bytes": rec.get("mem/temp_peak_bytes"),
+        "mem_preflight_fired": (
+            mem_preflights.get("build") or {}
+        ).get("fired"),
+        "serve_memory": "ok" if sv_result["mem_ok"] else "FAILED",
+        "serve_mem_resident_bytes": sv_rec.get("mem/resident_bytes"),
+        "serve_mem_headroom_bytes": sv_rec.get("serve/mem_headroom_bytes"),
         "tracing": "ok" if tracing_ok else "FAILED",
         "trace_train_spans": len(train_trace),
         "trace_serve_spans": len(serve_trace),
@@ -837,6 +930,11 @@ def serve_only() -> int:
         "spec_drafted": res["spec_drafted"],
         "spec_accepted": res["spec_accepted"],
         "spec_greedy_identity": res["greedy_identity"],
+        "serve_memory": "ok" if res["mem_ok"] else "FAILED",
+        "serve_mem_resident_bytes": res["record"].get("mem/resident_bytes"),
+        "serve_mem_headroom_bytes": res["record"].get(
+            "serve/mem_headroom_bytes"
+        ),
         "serve_cost_decode_bound": res["record"].get(
             "serve/cost_decode_bound"
         ),

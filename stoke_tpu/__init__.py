@@ -23,6 +23,7 @@ from stoke_tpu.configs import (
     FSDPConfig,
     HealthConfig,
     LossReduction,
+    MemoryConfig,
     MeshConfig,
     NumericsConfig,
     OffloadDiskConfig,
@@ -101,6 +102,7 @@ __all__ = [
     "FleetConfig",
     "FSDPConfig",
     "HealthConfig",
+    "MemoryConfig",
     "NumericsConfig",
     "OffloadDiskConfig",
     "OffloadOptimizerConfig",

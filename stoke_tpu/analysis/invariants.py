@@ -97,11 +97,12 @@ JSONL_EMITTER_MODULES: Tuple[str, ...] = (
     "stoke_tpu/serving/telemetry.py",
     "stoke_tpu/serving/slo.py",
     "stoke_tpu/serving/roofline.py",
+    "stoke_tpu/telemetry/memory.py",
 )
 #: emitter function names the JSONL rule inspects
 _JSONL_EMITTER_FNS = ("event_fields", "_event_fields", "_base_event_fields")
 #: namespaced key prefixes that identify a conditionally-emitted field
-_JSONL_NAMESPACES = ("fleet/", "resilience/", "serve/", "numerics/")
+_JSONL_NAMESPACES = ("fleet/", "resilience/", "serve/", "numerics/", "mem/")
 
 
 @dataclass

@@ -313,6 +313,133 @@ def _rel_dev(measured: float, pinned: float) -> float:
     return abs(measured - pinned) / max(abs(pinned), 1e-12)
 
 
+# --------------------------------------------------------------------------- #
+# per-program memory (ISSUE 19: the memory-drift gate's measurement leg)
+# --------------------------------------------------------------------------- #
+
+#: default relative temp/peak-bytes deviation above which
+#: audit-memory-drift fires (the manifest's "tolerance" key overrides).
+#: Looser than DEFAULT_COST_TOLERANCE on purpose: XLA's temp-buffer
+#: allocation shifts across compiler versions far more than its analytic
+#: FLOP count does — the gate exists to catch a refactor DOUBLING a
+#: buffer, not a version bump nudging padding
+DEFAULT_MEM_TOLERANCE = 0.25
+
+
+def spec_memory_entry(spec: ProgramSpec) -> Optional[Dict[str, Any]]:
+    """One memory-manifest entry for a serve spec: the compiled
+    executable's ``memory_analysis`` temp/peak bytes plus the geometry
+    signature.  Unlike :func:`spec_cost_entry` this REQUIRES a compile
+    (``memory_analysis`` lives on the executable, not the lowering) — so
+    the memory gate runs only where the cost gate's lowering-only
+    contract does not apply (``stoke_lint.py --programs``'s throwaway
+    engines, never ``Stoke.audit()``'s dispatch-count-pinned path unless
+    a manifest is explicitly supplied).  None when the backend reports
+    no memory analysis (the gate then notes itself unchecked)."""
+    from stoke_tpu.telemetry.attribution import memory_analysis_stats
+
+    if not hasattr(spec.fn, "lower"):
+        return None
+    stats = memory_analysis_stats(spec.fn, *spec.abstract_args)
+    if stats is None:
+        return None
+    peak = float(stats.get("peak_bytes", 0.0) or 0.0)
+    if peak <= 0:
+        return None
+    return {
+        "sig": cost_signature(spec.abstract_args),
+        "temp_bytes": float(stats.get("temp_bytes", 0.0) or 0.0),
+        "peak_bytes": peak,
+    }
+
+
+def _audit_memory_drift(
+    specs: Sequence[ProgramSpec],
+    report: "AuditReport",
+    mem_manifest: Dict[str, Any],
+    tolerance: float,
+) -> None:
+    """The memory-drift gate: serve specs' re-compiled memory_analysis
+    temp/peak bytes vs the committed manifest, both directions
+    (golden-file semantics, the _audit_cost_drift pattern)."""
+    pinned = mem_manifest.get("programs", {}) or {}
+    seen = set()
+    for spec in specs:
+        if spec.source != "serve" or spec.program in seen:
+            continue
+        seen.add(spec.program)
+        entry = spec_memory_entry(spec)
+        if entry is None:
+            report.notes.append(
+                f"audit-memory-drift not checked for {spec.program!r}: "
+                f"backend reports no XLA memory analysis"
+            )
+            continue
+        pin = pinned.get(spec.program)
+        if pin is None:
+            report.findings.append(
+                Finding(
+                    rule="audit-memory-drift",
+                    file=f"<jit:{spec.program}>",
+                    line=0,
+                    message=(
+                        f"serve program {spec.program!r} "
+                        f"({entry['peak_bytes']:.0f} peak bytes) has no "
+                        f"pinned entry in the program-memory manifest — "
+                        f"its HBM regressions would be invisible to CI"
+                    ),
+                    remedy=(
+                        "pin it: scripts/stoke_lint.py --update-mem "
+                        "rewrites analysis/manifests/program_memory.json "
+                        "from the live engines"
+                    ),
+                )
+            )
+            continue
+        if pin.get("sig") != entry["sig"]:
+            report.notes.append(
+                f"audit-memory-drift not checked for {spec.program!r}: "
+                f"argument geometry changed (sig {entry['sig']} vs "
+                f"pinned {pin.get('sig')}) — re-pin with "
+                f"scripts/stoke_lint.py --update-mem"
+            )
+            continue
+        for field_name, measured in (
+            ("temp_bytes", entry["temp_bytes"]),
+            ("peak_bytes", entry["peak_bytes"]),
+        ):
+            pinned_v = pin.get(field_name)
+            if pinned_v is None or measured is None:
+                continue
+            dev = _rel_dev(measured, pinned_v)
+            if dev <= tolerance:
+                continue
+            direction = "grew" if measured > pinned_v else "shrank"
+            report.findings.append(
+                Finding(
+                    rule="audit-memory-drift",
+                    file=f"<jit:{spec.program}>",
+                    line=0,
+                    message=(
+                        f"serve program {spec.program!r} "
+                        f"{field_name} {direction} {dev:.1%} vs the "
+                        f"pinned manifest ({measured:.0f} vs "
+                        f"{pinned_v:.0f}, tolerance {tolerance:.0%}) at "
+                        f"UNCHANGED argument geometry — a refactor "
+                        f"changed this program's HBM footprint per "
+                        f"dispatch"
+                    ),
+                    remedy=(
+                        "if the footprint change is intentional, re-pin "
+                        "with scripts/stoke_lint.py --update-mem; "
+                        "otherwise find the buffer the refactor "
+                        "grew/dropped (compare memory_analysis against "
+                        "the last good commit)"
+                    ),
+                )
+            )
+
+
 def _audit_cost_drift(
     specs: Sequence[ProgramSpec],
     report: "AuditReport",
@@ -687,15 +814,26 @@ def audit_program_specs(
     replicated_bytes_threshold: int = DEFAULT_REPLICATED_BYTES,
     cost_manifest: Optional[Dict[str, Any]] = None,
     cost_tolerance: Optional[float] = None,
+    mem_manifest: Optional[Dict[str, Any]] = None,
+    mem_tolerance: Optional[float] = None,
 ) -> AuditReport:
     """Audit every recorded program spec.  Lowering/tracing only — no
     compile, no dispatch (``Stoke.audit()`` asserts dispatch-count
-    equality on top of this contract).
+    equality on top of this contract) — EXCEPT the opt-in memory-drift
+    gate below, whose measurement requires a compile.
 
     ``cost_manifest`` (ISSUE 18) arms the cost-drift gate: the parsed
     ``analysis/manifests/program_costs.json`` dict, against which every
     serve spec's re-lowered analytic FLOPs/bytes are compared
-    (``cost_tolerance`` overrides the manifest's own tolerance)."""
+    (``cost_tolerance`` overrides the manifest's own tolerance).
+
+    ``mem_manifest`` (ISSUE 19) arms the memory-drift gate the same way
+    with ``analysis/manifests/program_memory.json``: every serve spec is
+    re-COMPILED (``memory_analysis`` lives on the executable — supplying
+    this manifest opts out of the no-compile contract for those specs)
+    and its temp/peak bytes compared both directions at matching
+    geometry signature (``mem_tolerance`` overrides the manifest's
+    own)."""
     report = AuditReport()
     for spec in specs:
         report.programs.append(spec.program)
@@ -762,5 +900,23 @@ def audit_program_specs(
             "audit-cost-drift not checked: no program-cost manifest "
             "supplied (scripts/stoke_lint.py --programs passes the "
             "committed analysis/manifests/program_costs.json)"
+        )
+    # memory-drift gate (ISSUE 19): armed only when a manifest is
+    # supplied — same serve-spec scope and note-not-silence discipline as
+    # the cost gate, but the measurement compiles (see docstring)
+    if mem_manifest is not None:
+        tol = (
+            mem_tolerance
+            if mem_tolerance is not None
+            else float(
+                mem_manifest.get("tolerance", DEFAULT_MEM_TOLERANCE)
+            )
+        )
+        _audit_memory_drift(specs, report, mem_manifest, tol)
+    elif any(spec.source == "serve" for spec in specs):
+        report.notes.append(
+            "audit-memory-drift not checked: no program-memory manifest "
+            "supplied (scripts/stoke_lint.py --programs passes the "
+            "committed analysis/manifests/program_memory.json)"
         )
     return report

@@ -473,6 +473,13 @@ class StepEngine:
         # signature and account analytic FLOPs/bytes per dispatch.  None
         # -> zero bookkeeping, programs untouched.
         self._attribution = None
+        # memory observatory (ISSUE 19): assigned by the facade when a
+        # MemoryConfig is supplied.  _aot_call reports (program, fn, live
+        # args, signature) so the observatory's CostCardCache can run ONE
+        # XLA memory_analysis per program signature — temp/argument/
+        # output peaks for the OOM pre-flight and the memory-drift gate.
+        # None -> zero bookkeeping, programs untouched.
+        self._memory = None
         # persistent AOT compile cache (ISSUE 6): assigned by the facade
         # when a CompileConfig is supplied.  Each step-program dispatch
         # site resolves its callable through _aot_call: with a cache, the
@@ -770,6 +777,7 @@ class StepEngine:
             tracker is None
             and self._attribution is None
             and self._compile_cache is None
+            and self._memory is None
         ):
             return None
         sig = self._shape_sig(batch_trees)
@@ -817,6 +825,8 @@ class StepEngine:
         if self._chaos is not None:
             self._chaos.on_dispatch(program)
         self._note_audit(program, key, sig, fn, args)
+        if self._memory is not None:
+            self._memory.note_program(program, fn, args, (key, sig))
         cache = self._compile_cache
         if cache is None:
             return fn

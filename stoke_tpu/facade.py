@@ -82,12 +82,13 @@ def _on_accelerator(leaf) -> bool:
 
 def _device_memory_stats() -> Optional[dict]:
     """Memory stats of the first local device, or None where the backend
-    doesn't report them (CPU simulator)."""
-    try:
-        stats = jax.local_devices()[0].memory_stats()
-    except Exception:
-        return None
-    return stats or None
+    doesn't report them (CPU simulator).  Delegates to the shared
+    None-tolerant reader in ``telemetry/collectors.py`` (the PR-15
+    shared-normalizer discipline: one ``device.memory_stats()`` probe,
+    not two drifting copies)."""
+    from stoke_tpu.telemetry.collectors import hbm_stats
+
+    return hbm_stats() or None
 
 
 def _check_segment_memory(seg_bytes: int, stats: Optional[dict]) -> None:
@@ -687,6 +688,57 @@ class Stoke:
             if self._resilience.chaos.active:
                 # engine pre-dispatch hook only when a chaos spec is armed
                 self._engine._chaos = self._resilience.chaos
+
+        # ----- HBM capacity observatory (ISSUE 19: per-subsystem memory
+        #       ledger, per-program memory_analysis peaks, OOM pre-flight;
+        #       default OFF — without a MemoryConfig no observatory is
+        #       constructed, no mem/* field or gauge exists anywhere, and
+        #       the compiled programs are bit-identical) -----
+        self._memory_obs = None
+        mcfg = st.memory_config
+        if mcfg is not None:
+            from stoke_tpu import offload as _offload
+            from stoke_tpu.telemetry.memory import (
+                MemoryObservatory,
+                transport_resident_bytes,
+                tree_resident_bytes,
+            )
+
+            obs = MemoryObservatory(mcfg, self._telemetry.registry)
+            obs.set_component(
+                "params", lambda: tree_resident_bytes(self._variables)
+            )
+            # the disk store spills the optimizer state between steps
+            # (self._opt_state is None then) — resident bytes are 0, the
+            # transient reload is the step program's temp, not the ledger
+            obs.set_component(
+                "opt_state",
+                lambda: (
+                    0
+                    if self._opt_state is None
+                    else tree_resident_bytes(self._opt_state)
+                ),
+            )
+            # per-shard via the transport's layout descriptor: the PR-8
+            # sharded transport ledgers 1/world of the buckets + residual,
+            # the PR-2 replicated one a full copy (None when inactive -> 0)
+            obs.set_component(
+                "transport",
+                lambda: transport_resident_bytes(
+                    self._engine.transport.layout_descriptor(
+                        self._variables["params"]
+                    )
+                ),
+            )
+            obs.set_component("snapshot", _offload.staged_nbytes)
+            self._memory_obs = obs
+            self._telemetry.memory = obs
+            # engine dispatch-funnel hook: one memory_analysis per
+            # distinct (program, signature) at _aot_call
+            self._engine._memory = obs
+            # OOM pre-flight at build: resident-only (no program has
+            # dispatched yet); warns BEFORE the first step can allocate
+            obs.preflight("build")
 
         # ----- wall-clock breakdown (reference wall_clock_breakdown,
         #       configs.py:540; host-side dispatch times — device work is
@@ -1437,6 +1489,25 @@ class Stoke:
         return self._numerics.summary()
 
     @property
+    def memory(self):
+        """The run's HBM capacity observatory (None without a
+        ``MemoryConfig``) — subsystem ledger callables, per-program
+        memory cards, pre-flight verdicts."""
+        return self._memory_obs
+
+    @property
+    def memory_summary(self) -> Optional[Dict[str, Any]]:
+        """HBM capacity ledger (ISSUE 19): subsystems ranked by resident
+        bytes (params / optimizer state / grad transport / KV cache /
+        staged snapshots — the components recombine exactly into the
+        resident total), per-program ``memory_analysis`` peaks, the OOM
+        pre-flight verdicts, and the analytic-vs-live reconciliation.
+        None without a ``MemoryConfig``."""
+        if self._memory_obs is None:
+            return None
+        return self._memory_obs.summary()
+
+    @property
     def health(self) -> Optional[HealthMonitor]:
         """The run's health monitor (None without a ``HealthConfig``)."""
         return self._health
@@ -1517,6 +1588,8 @@ class Stoke:
         churn_threshold: Optional[int] = None,
         cost_manifest: Optional[dict] = None,
         cost_tolerance: Optional[float] = None,
+        mem_manifest: Optional[dict] = None,
+        mem_tolerance: Optional[float] = None,
     ):
         """Static program audit of this LIVE build (ISSUE 15): re-lower
         every step program the engine has dispatched (and, with
@@ -1561,6 +1634,13 @@ class Stoke:
             kwargs["cost_manifest"] = cost_manifest
         if cost_tolerance is not None:
             kwargs["cost_tolerance"] = cost_tolerance
+        if mem_manifest is not None:
+            # memory-drift gate (ISSUE 19): re-compile each serve spec and
+            # compare its memory_analysis temp/peak bytes against the
+            # committed manifest (both directions, grew AND shrank)
+            kwargs["mem_manifest"] = mem_manifest
+        if mem_tolerance is not None:
+            kwargs["mem_tolerance"] = mem_tolerance
         report = audit_program_specs(
             specs,
             transport_active=self._engine.transport.active,
@@ -3064,6 +3144,10 @@ class Stoke:
                 if scfg.cost_cards
                 else None
             ),
+            # HBM capacity observatory (ISSUE 19): the engine constructs
+            # its OWN observatory (quantized weights + KV pool components)
+            # and runs the serve-side OOM pre-flight at construction
+            memory=self._status_obj.memory_config,
         )
         if self._numerics is not None and engine.quant_errors_by_group:
             # per-layer dequant-error attribution (ISSUE 12): the engine

@@ -39,6 +39,7 @@ __all__ = [
     "StagedSnapshot",
     "stage_tree",
     "drain_staged",
+    "staged_nbytes",
 ]
 
 
@@ -377,3 +378,31 @@ def drain_staged() -> None:
                 return
             snap = _INFLIGHT_STAGED[0]
         snap.resolve()
+
+
+def staged_nbytes() -> int:
+    """Device bytes currently held by in-flight staged snapshots — the
+    ISSUE 19 memory ledger's ``snapshot`` component.  Each unresolved
+    snapshot pins a decoupling device copy until :meth:`StagedSnapshot
+    .resolve` releases it; this sums the pending shard bytes of every
+    snapshot still in the deque.  Race-tolerant by construction: a
+    snapshot resolving mid-walk contributes whatever of its pending list
+    the local copies below captured — the ledger reads 0 for it next
+    window, never raises."""
+    total = 0
+    with _STAGED_LOCK:
+        snaps = list(_INFLIGHT_STAGED)
+    for snap in snaps:
+        for kind, rec in list(snap._pending):
+            if kind != "array":
+                continue
+            _shape, dtype, shards = rec
+            for _key, data in list(shards):
+                shard_shape = getattr(data, "shape", None)
+                if shard_shape is None:
+                    continue
+                n = 1
+                for dim in shard_shape:
+                    n *= int(dim)
+                total += n * dtype.itemsize
+    return int(total)

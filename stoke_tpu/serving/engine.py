@@ -139,6 +139,13 @@ class ServingEngine:
             ``peak_hbm_gbps``) the ISSUE 18 cost observatory rooflines
             against — required when ``cfg.cost_cards`` is on (the facade
             passes the run's config; standalone engines construct one).
+        memory: optional :class:`~stoke_tpu.configs.MemoryConfig`
+            (ISSUE 19) — arms the HBM capacity observatory: the engine
+            registers its own subsystems (quantized weights, KV page
+            pool), runs the serve-side OOM pre-flight at construction,
+            and forecasts ``serve/mem_headroom_bytes`` (free-pool bytes
+            minus the queue's worst-case block demand) every gauge
+            refresh.  None (the default) constructs nothing.
     """
 
     def __init__(
@@ -152,6 +159,7 @@ class ServingEngine:
         compile_cache=None,
         kv_sharding=None,
         attribution=None,
+        memory=None,
     ):
         if not isinstance(model, GPT):
             raise TypeError(
@@ -429,6 +437,29 @@ class ServingEngine:
                     self._decode_jit, self._decode_baseline_args()
                 )
 
+        # HBM capacity observatory (ISSUE 19): same host-side discipline
+        # as the cost cards — never enters an argument list, so the
+        # compiled serve programs stay HLO bit-identical with and without
+        # it.  The engine registers the two subsystems it owns (the
+        # quantized weight store and the KV page pool) and runs the
+        # serve-side OOM pre-flight HERE, before the first request can
+        # allocate a block.
+        self._memory = None
+        if memory is not None:
+            from stoke_tpu.telemetry.memory import (
+                MemoryObservatory,
+                tree_resident_bytes,
+            )
+
+            self._memory = MemoryObservatory(memory, self.metrics.registry)
+            self._memory.set_component(
+                "params", lambda: tree_resident_bytes(self.qparams)
+            )
+            self._memory.set_component(
+                "kv_cache", lambda: self.cache.nbytes
+            )
+            self._memory.preflight("serve")
+
         self._iterations = 0
         self._last_emit_iter = 0
         self._t_start = time.perf_counter()
@@ -695,6 +726,8 @@ class ServingEngine:
         self._note_audit(program, fn, args)
         if self._cost is not None:
             self._cost.note_dispatch(program, fn, args, self._sig(args))
+        if self._memory is not None:
+            self._memory.note_program(program, fn, args, self._sig(args))
         cc = self._compile_cache
         if cc is not None:
             fn = cc.executable(program, (program, self._sig(args)), fn, args)
@@ -1233,6 +1266,26 @@ class ServingEngine:
             self._cost.refresh_gauges()
             self.slo.set_flops_per_token(self._cost.flops_per_token())
         self.slo.refresh_gauges()
+        if self._memory is not None:
+            self._memory.note_serve_headroom(self._mem_headroom_bytes())
+            self._memory.refresh_gauges()
+
+    def _mem_headroom_bytes(self) -> float:
+        """KV-pool headroom forecast (ISSUE 19): free-pool bytes minus
+        the worst-case blocks-to-completion still owed to in-flight work.
+        Admission reserves every ACTIVE request's full worst-case budget
+        up front (the allocator contract), so the outstanding demand is
+        the QUEUE's: each queued request will claim
+        ``blocks_for(prompt + max_new_tokens)`` at admission.  Negative
+        headroom forecasts that the queue cannot be admitted against the
+        current pool — the bursty-admission signal."""
+        alloc = self.allocator
+        queued_blocks = sum(
+            alloc.blocks_for(req.prompt.size + req.max_new_tokens)
+            for req in self.scheduler.queue
+        )
+        bytes_per_block = self.cache.nbytes / max(alloc.num_blocks, 1)
+        return (alloc.free_blocks - queued_blocks) * bytes_per_block
 
     def emit_record(self) -> Optional[dict]:
         """Write one JSONL serve record through the telemetry pipeline
@@ -1258,7 +1311,16 @@ class ServingEngine:
                     if self._cost is not None
                     else {}
                 ),
+                **(
+                    self._memory.serve_event_fields()
+                    if self._memory is not None
+                    else {}
+                ),
             },
+            # the serve record's mem/* ledger is THIS engine's (quantized
+            # weights + KV pool), not the train facade's — record_step
+            # falls back to the pipeline's observatory only when None
+            memory=self._memory,
         )
 
     # ------------------------------------------------------------------ #
@@ -1304,6 +1366,15 @@ class ServingEngine:
             "cost": (
                 self._cost.summary()
                 if self._cost is not None
+                else {"active": False}
+            ),
+            # HBM capacity observatory (ISSUE 19): {"active": False}
+            # without a MemoryConfig, else the subsystem ledger, the
+            # serve OOM pre-flight verdict, per-program memory cards,
+            # and the KV headroom forecast
+            "memory": (
+                self._memory.summary()
+                if self._memory is not None
                 else {"active": False}
             ),
         }

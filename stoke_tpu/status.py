@@ -44,6 +44,7 @@ from stoke_tpu.configs import (
     DistributedOptions,
     FleetConfig,
     FSDPConfig,
+    MemoryConfig,
     MeshConfig,
     NumericsConfig,
     OffloadDiskConfig,
@@ -768,6 +769,37 @@ class StokeStatus:
                 )
             return False
 
+        def _memory_invalid(s):
+            """HBM-observatory legality (ISSUE 19): the ledger surfaces
+            through the telemetry pipeline (so a TelemetryConfig is
+            required), the pre-flight margin must be a usable fraction,
+            and a capacity override must be a positive byte count (the
+            silently-ignored-knob anti-pattern: a zero/negative capacity
+            would make the pre-flight fire always or never)."""
+            cfg = self._configs.get("MemoryConfig")
+            if cfg is None:
+                return False
+            if "TelemetryConfig" not in self._configs:
+                return (
+                    "MemoryConfig requires a TelemetryConfig — the HBM "
+                    "capacity ledger surfaces through the telemetry step "
+                    "events; add one or drop the config"
+                )
+            if not (0.0 < cfg.oom_margin_frac <= 1.0):
+                return (
+                    f"MemoryConfig.oom_margin_frac must be in (0, 1] — "
+                    f"the pre-flight warns when predicted peak crosses "
+                    f"that fraction of capacity; got "
+                    f"{cfg.oom_margin_frac}"
+                )
+            if cfg.capacity_bytes is not None and cfg.capacity_bytes <= 0:
+                return (
+                    f"MemoryConfig.capacity_bytes must be a positive "
+                    f"byte count when set (None reads the live "
+                    f"memory_stats limit); got {cfg.capacity_bytes}"
+                )
+            return False
+
         def _checkpoint_invalid(s):
             """Checkpoint-layout legality (ISSUE 14, extended by ISSUE
             15's knob-coverage lint): the periodic-save cadence must be
@@ -1450,6 +1482,10 @@ class StokeStatus:
                 "NumericsConfig is invalid for this combination",
             ),
             (
+                _memory_invalid,
+                "MemoryConfig is invalid for this combination",
+            ),
+            (
                 _checkpoint_invalid,
                 "CheckpointConfig is invalid",
             ),
@@ -1719,6 +1755,13 @@ class StokeStatus:
         observatory is opt-in; without it the compiled step programs are
         bit-identical to pre-ISSUE-12)."""
         return self._configs.get("NumericsConfig")
+
+    @property
+    def memory_config(self) -> Optional[MemoryConfig]:
+        """None unless explicitly supplied (the HBM capacity observatory
+        is opt-in; without it no ``mem/*`` field or gauge exists and the
+        compiled programs are bit-identical to pre-ISSUE-19)."""
+        return self._configs.get("MemoryConfig")
 
     @property
     def resilience_config(self) -> Optional[ResilienceConfig]:

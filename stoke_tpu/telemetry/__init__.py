@@ -95,6 +95,12 @@ from stoke_tpu.telemetry.numerics import (
     unpack_group_stats,
     wire_residual_group_norms,
 )
+from stoke_tpu.telemetry.memory import (
+    MEM_FIELDS,
+    MemoryObservatory,
+    transport_resident_bytes,
+    tree_resident_bytes,
+)
 from stoke_tpu.telemetry.recorder import FlightRecorder
 from stoke_tpu.telemetry.tracing import (
     TRACE_EVENT_KEYS,
@@ -191,6 +197,11 @@ __all__ = [
     "quant_error_by_group",
     "unpack_group_stats",
     "wire_residual_group_norms",
+    # HBM capacity observatory (ISSUE 19)
+    "MEM_FIELDS",
+    "MemoryObservatory",
+    "transport_resident_bytes",
+    "tree_resident_bytes",
     # structured tracing (ISSUE 10)
     "TRACE_EVENT_KEYS",
     "ComposedContext",
@@ -244,6 +255,10 @@ class Telemetry:
         # when a NumericsConfig is supplied; None keeps the numerics/*
         # keys out of every step event entirely
         self.numerics = None
+        # HBM capacity observatory (ISSUE 19) — assigned by the facade
+        # when a MemoryConfig is supplied; None keeps the mem/* keys out
+        # of every step event entirely
+        self.memory = None
         # cross-process sync timings (Stoke.barrier / checkpoint
         # sync_global_devices) land in this registry even when no
         # TelemetryConfig drives sinks — the wall-clock breakdown and
@@ -436,6 +451,7 @@ class Telemetry:
         tokens_hint: Optional[float] = None,
         ts: Optional[float] = None,
         serve: Optional[Dict[str, Any]] = None,
+        memory=None,
     ) -> Optional[dict]:
         """Assemble one structured step event from the registry state and
         fan it to every sink.  Called by the facade at the logging cadence;
@@ -554,6 +570,18 @@ class Telemetry:
         if self.numerics is not None:
             numerics_fields = self.numerics.event_fields()
 
+        # HBM capacity ledger (ISSUE 19): the analytic per-subsystem
+        # resident ledger + OOM forecast rides every record when an
+        # observatory is attached — pure host arithmetic over
+        # shape/dtype trees, no device touches
+        # (a ServingEngine passes its OWN observatory via ``memory=`` so
+        # serve records ledger the serving subsystems, not the train ones)
+        memory_obs = memory if memory is not None else self.memory
+        memory_fields: Optional[dict] = None
+        if memory_obs is not None:
+            memory_obs.refresh_gauges()
+            memory_fields = memory_obs.event_fields()
+
         hbm = hbm_stats() if self.config.track_hbm else None
         record = build_step_event(
             ts=now,
@@ -596,6 +624,7 @@ class Telemetry:
             # them — training records stay free of every serve/* key
             serve=serve,
             numerics=numerics_fields,
+            memory=memory_fields,
             **attr_fields,
         )
         snapshot = self.registry.snapshot()

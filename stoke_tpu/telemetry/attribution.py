@@ -129,6 +129,38 @@ def cost_analysis_of(fn, *args, backend: Optional[str] = None):
     return cost
 
 
+def memory_analysis_stats(fn, *args) -> Optional[Dict[str, float]]:
+    """Component breakdown of jitted ``fn``'s compiled
+    ``memory_analysis()`` at ``args``: argument / output / temp /
+    generated-code / alias bytes plus the derived ``peak_bytes``
+    (argument + output + temp - aliased) — the ISSUE 19 per-program
+    memory card.  REQUIRES a compile, so callers pay it only on explicit
+    opt-in (``CostCardCache(memory_analysis=True)``); ``None`` whenever
+    the backend or jax version cannot answer."""
+    try:
+        stats = fn.lower(*args).compile().memory_analysis()
+        if stats is None:
+            return None
+        out = {
+            "argument_bytes": float(stats.argument_size_in_bytes),
+            "output_bytes": float(stats.output_size_in_bytes),
+            "temp_bytes": float(stats.temp_size_in_bytes),
+            "alias_bytes": float(stats.alias_size_in_bytes),
+            "generated_code_bytes": float(
+                getattr(stats, "generated_code_size_in_bytes", 0.0)
+            ),
+        }
+        out["peak_bytes"] = (
+            out["argument_bytes"]
+            + out["output_bytes"]
+            + out["temp_bytes"]
+            - out["alias_bytes"]
+        )
+        return out
+    except Exception:
+        return None
+
+
 def memory_analysis_bytes(fn, *args) -> Optional[float]:
     """Best-effort peak-HBM estimate of jitted ``fn`` at ``args`` from
     the compiled executable's ``memory_analysis()`` (argument + output +
@@ -136,19 +168,11 @@ def memory_analysis_bytes(fn, *args) -> Optional[float]:
     REQUIRES a compile, so callers pay it only on explicit opt-in (the
     serve roofline observatory's per-program cards); ``None`` whenever
     the backend or jax version cannot answer."""
-    try:
-        stats = fn.lower(*args).compile().memory_analysis()
-        if stats is None:
-            return None
-        total = (
-            float(stats.argument_size_in_bytes)
-            + float(stats.output_size_in_bytes)
-            + float(stats.temp_size_in_bytes)
-            - float(stats.alias_size_in_bytes)
-        )
-        return total if total > 0 else None
-    except Exception:
+    stats = memory_analysis_stats(fn, *args)
+    if stats is None:
         return None
+    total = stats["peak_bytes"]
+    return total if total > 0 else None
 
 
 def _note_cost_unavailable(backend: str, reason) -> None:
@@ -181,6 +205,10 @@ class CostCard:
     #: compiled peak-HBM estimate (memory_analysis; None unless a caller
     #: opted into the extra AOT compile — see memory_analysis_bytes)
     peak_hbm_bytes: Optional[float] = None
+    #: memory_analysis component breakdown (argument/output/temp/alias/
+    #: generated-code/peak bytes; same opt-in — the ISSUE 19 memory
+    #: observatory's per-program card)
+    mem_stats: Optional[Dict[str, float]] = None
 
     @property
     def intensity(self) -> Optional[float]:
@@ -405,7 +433,10 @@ class CostCardCache:
                     self.peak_hbm_gbps,
                 )
                 if self.memory_analysis:
-                    card.peak_hbm_bytes = memory_analysis_bytes(fn, *args)
+                    card.mem_stats = memory_analysis_stats(fn, *args)
+                    if card.mem_stats is not None:
+                        peak = card.mem_stats["peak_bytes"]
+                        card.peak_hbm_bytes = peak if peak > 0 else None
                 self.registry.counter(
                     f"{self.counter_prefix}/cost_cards_total"
                 ).inc()

@@ -249,6 +249,12 @@ STEP_EVENT_FIELDS: Dict[str, tuple] = {
     "serve/cost_verify_intensity": (False, "nullable_number"),
     "serve/cost_decode_bound": (False, "nullable_string"),
     "serve/cost_cards": (False, "nullable_number"),
+    # serve KV-headroom forecast (ISSUE 19; key absent without a
+    # MemoryConfig — a memory-free engine's records are byte-identical
+    # to pre-ISSUE-19 ones): free KV-pool bytes minus the worst-case
+    # blocks-to-completion of every in-flight request (negative =
+    # admission has over-committed the pool)
+    "serve/mem_headroom_bytes": (False, "nullable_number"),
     # per-layer numerics observatory (ISSUE 12; keys absent without a
     # NumericsConfig): groups is the fixed group count of the run's param
     # tree; per_group the nullable {group: {stat: value}} block (grad/
@@ -265,6 +271,29 @@ STEP_EVENT_FIELDS: Dict[str, tuple] = {
     "numerics/provenance_field": (False, "nullable_string"),
     "numerics/quant_err_max": (False, "nullable_number"),
     "numerics/quant_err_group": (False, "nullable_string"),
+    # HBM capacity ledger (ISSUE 19; keys absent without a MemoryConfig
+    # — an unconfigured run's records are byte-identical to pre-ISSUE-19
+    # ones): the analytic per-subsystem resident ledger (per-device
+    # bytes from shape/dtype/sharding trees — the five components
+    # recombine EXACTLY into resident_bytes; unregistered subsystems are
+    # null, empty ones 0), the max-over-programs memory_analysis temp
+    # peak, the predicted peak (resident + temp), device capacity
+    # (MemoryConfig.capacity_bytes override or live bytes_limit; null on
+    # the CPU simulator), headroom = capacity - predicted peak, and the
+    # reconciliation gauge: live bytes-in-use minus the analytic
+    # resident total (fragmentation / unledgered subsystems; null
+    # without memory_stats)
+    "mem/params_bytes": (False, "nullable_number"),
+    "mem/opt_state_bytes": (False, "nullable_number"),
+    "mem/transport_bytes": (False, "nullable_number"),
+    "mem/kv_cache_bytes": (False, "nullable_number"),
+    "mem/snapshot_bytes": (False, "nullable_number"),
+    "mem/resident_bytes": (False, "nullable_number"),
+    "mem/temp_peak_bytes": (False, "nullable_number"),
+    "mem/predicted_peak_bytes": (False, "nullable_number"),
+    "mem/capacity_bytes": (False, "nullable_number"),
+    "mem/headroom_bytes": (False, "nullable_number"),
+    "mem/unattributed_bytes": (False, "nullable_number"),
     "hbm_bytes_in_use": (False, "nullable_number"),
     "hbm_peak_bytes": (False, "nullable_number"),
     "hbm_bytes_limit": (False, "nullable_number"),
@@ -319,6 +348,21 @@ SERVE_SPEC_FIELDS = tuple(
 #: discipline)
 SERVE_COST_FIELDS = tuple(
     f for f in SERVE_STEP_FIELDS if f.startswith("serve/cost_")
+)
+
+#: the serve memory-headroom subset (ISSUE 19): emitted ONLY by engines
+#: with a MemoryConfig — the MemoryObservatory's field is merged into
+#: the serve dict only when it exists, and ``build_step_event`` honors
+#: the omission (the SERVE_SLO_FIELDS discipline)
+SERVE_MEM_FIELDS = tuple(
+    f for f in SERVE_STEP_FIELDS if f.startswith("serve/mem_")
+)
+
+#: the HBM capacity-ledger subset (ISSUE 19; populated via
+#: ``build_step_event``'s ``memory=`` dict; MemoryObservatory
+#: .event_fields must match)
+MEM_STEP_FIELDS = tuple(
+    f for f in STEP_EVENT_FIELDS if f.startswith("mem/")
 )
 
 #: the per-layer-numerics subset (populated via ``build_step_event``'s
@@ -469,6 +513,7 @@ def build_step_event(
     resilience: Optional[Dict[str, Any]] = None,
     serve: Optional[Dict[str, Any]] = None,
     numerics: Optional[Dict[str, Any]] = None,
+    memory: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Assemble + validate a v1 step event (single construction point so the
     schema cannot drift from the writer)."""
@@ -589,12 +634,15 @@ def build_step_event(
         # emits the record — a training run's JSONL never carries them
         for key in SERVE_STEP_FIELDS:
             if (
-                key in SERVE_SLO_FIELDS or key in SERVE_COST_FIELDS
+                key in SERVE_SLO_FIELDS
+                or key in SERVE_COST_FIELDS
+                or key in SERVE_MEM_FIELDS
             ) and key not in serve:
                 # SLO keys ride only once a request carried a RequestSLO
                 # (ISSUE 16 default-OFF contract: zero new JSONL fields);
-                # cost keys only with ServeConfig.cost_cards (ISSUE 18,
-                # same contract)
+                # cost keys only with ServeConfig.cost_cards (ISSUE 18),
+                # memory headroom only with a MemoryConfig (ISSUE 19) —
+                # same contract
                 continue
             value = serve.get(key)
             if key == "serve/cost_decode_bound":
@@ -643,6 +691,18 @@ def build_step_event(
         if unknown:
             raise ValueError(
                 f"unknown numerics step-event fields {sorted(unknown)}"
+            )
+    if memory is not None:
+        # HBM capacity ledger (ISSUE 19): keys appear only when a
+        # MemoryObservatory is attached; slash-named fields arrive as
+        # one dict like the fleet view's — unknown keys fail validation
+        for key in MEM_STEP_FIELDS:
+            value = memory.get(key)
+            record[key] = None if value is None else float(value)
+        unknown = set(memory) - set(MEM_STEP_FIELDS)
+        if unknown:
+            raise ValueError(
+                f"unknown memory step-event fields {sorted(unknown)}"
             )
     validate_step_event(record)
     return record

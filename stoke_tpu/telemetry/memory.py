@@ -1,0 +1,425 @@
+"""HBM capacity observatory (ISSUE 19): per-subsystem memory ledger,
+OOM pre-flight, and per-program peak capture.
+
+The observability stack explains *time* (PR-10 attribution, PR-16 SLOs,
+PR-18 roofline) but not *bytes*: HBM was two coarse watermark gauges
+with no attribution.  This module closes that gap:
+
+- **Analytic resident ledger** — per-device bytes of each subsystem,
+  computed from shape/dtype/sharding trees alone (no device probes):
+  params, optimizer state, grad-transport buckets + error-feedback
+  residual (per-shard, from :meth:`GradTransport.layout_descriptor` —
+  the ISSUE 8 sharded transport holds 1/world of the residual each
+  device, the ISSUE 2 replicated one a full copy), the serving KV block
+  pool, and in-flight staged-snapshot buffers.  The components recombine
+  EXACTLY into the reported resident total (the PR-18 recombination
+  discipline: a ledger whose parts do not sum is a lying ledger).
+- **Per-program memory cards** — the compiled executable's
+  ``memory_analysis()`` component breakdown (argument / output / temp /
+  generated-code bytes) per (program, shape signature), captured through
+  the already-``memory_analysis``-parameterized
+  :class:`~stoke_tpu.telemetry.attribution.CostCardCache` at both
+  dispatch funnels (``StepEngine._aot_call`` /
+  ``ServingEngine._dispatch``).
+- **OOM pre-flight** — predicted peak = resident + max-over-programs
+  temp peak, compared against device capacity at ``build()`` /
+  ``serve()``: a predicted squeeze warns BEFORE the first dispatch, with
+  the largest contributors ranked and remedies named.
+- **Reconciliation** — ``mem/unattributed_bytes`` = live
+  ``memory_stats()`` bytes-in-use minus the analytic resident total, on
+  backends that report stats (None on the CPU simulator): a growing gap
+  is allocator fragmentation or an unledgered subsystem.
+- **Serve headroom forecast** — ``serve/mem_headroom_bytes``: the KV
+  pool's free bytes minus the worst-case blocks-to-completion of every
+  in-flight request (the engine computes the block demand; this module
+  carries the gauge/JSONL field), feeding the admission story.
+
+Everything is host-side arithmetic over trees the run already holds:
+with ``MemoryConfig`` absent nothing here is constructed, records carry
+zero new fields, and the dispatched programs are HLO bit-identical; with
+it on, the only extra device-adjacent work is one ``memory_analysis``
+compile per distinct program signature (the PR-18 opt-in price).
+
+The ``mem/*`` JSONL block is conditional — absent, not null, without
+the config — and its field list is pinned append-only in
+``analysis/manifests/wire_formats.json``.
+"""
+
+from __future__ import annotations
+
+import math
+import warnings
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from stoke_tpu.telemetry.attribution import CostCardCache
+from stoke_tpu.telemetry.collectors import hbm_stats
+
+#: the ``mem/*`` JSONL field block (ISSUE 19) — emitted only by runs
+#: with a ``MemoryConfig`` (the default-OFF contract: unconfigured
+#: records carry zero new fields).  Pinned append-only by the
+#: ``analysis/manifests/wire_formats.json`` manifest.
+MEM_FIELDS = (
+    "mem/params_bytes",
+    "mem/opt_state_bytes",
+    "mem/transport_bytes",
+    "mem/kv_cache_bytes",
+    "mem/snapshot_bytes",
+    "mem/resident_bytes",
+    "mem/temp_peak_bytes",
+    "mem/predicted_peak_bytes",
+    "mem/capacity_bytes",
+    "mem/headroom_bytes",
+    "mem/unattributed_bytes",
+)
+
+#: the ledger's subsystem components, in emission order — the five
+#: ``mem/<name>_bytes`` JSONL fields above.  ``resident`` is their exact
+#: sum (unregistered components count 0), never an independent number.
+LEDGER_COMPONENTS: Tuple[str, ...] = (
+    "params", "opt_state", "transport", "kv_cache", "snapshot",
+)
+
+#: per-component remedy named by the OOM pre-flight (the status.py
+#: discipline: every warning says what to do about it)
+_COMPONENT_REMEDIES = {
+    "params": "shard parameters across the mesh (partition rules) or "
+              "serve quantized weights (ServeConfig.quantization)",
+    "opt_state": "shard the optimizer state (CommConfig "
+                 "shard_updates / ZeRO path) or offload it to disk "
+                 "(OffloadConfig)",
+    "transport": "use the sharded transport (CommConfig shard_updates: "
+                 "buckets and EF residual drop to 1/world per device)",
+    "kv_cache": "lower ServeConfig.kv_blocks / max_seqs / max_seq_len, "
+                "or quantize the KV cache",
+    "snapshot": "lower the staged-snapshot overlap (offload.MAX_STAGED) "
+                "or checkpoint less often",
+}
+
+
+def tree_resident_bytes(tree) -> int:
+    """Analytic per-device resident bytes of a pytree: each array leaf
+    contributes its LOCAL shard (``sharding.shard_shape`` when the leaf
+    carries a mesh placement, the full shape otherwise) times its dtype
+    width.  Pure host arithmetic — no device touches, safe pre-dispatch.
+    """
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None:
+            try:
+                shape = sharding.shard_shape(tuple(shape))
+            except Exception:
+                pass  # non-mesh placements fall back to the full shape
+        try:
+            itemsize = dtype.itemsize
+        except AttributeError:
+            import numpy as np
+
+            itemsize = np.dtype(dtype).itemsize
+        total += math.prod(shape) * itemsize
+    return int(total)
+
+
+def transport_resident_bytes(
+    descriptor: Optional[Dict[str, Any]],
+) -> int:
+    """Per-device resident bytes of the gradient transport, from its
+    :meth:`~stoke_tpu.parallel.collectives.GradTransport
+    .layout_descriptor`: the padded fp32 bucket buffers plus (with error
+    feedback) the carried residual.  The replicated transport (ISSUE 2)
+    holds full buckets and a full per-leaf residual on every device; the
+    sharded one (ISSUE 8) holds 1/world of each — the topology-dependent
+    resident set the analytic ledger exists to pin."""
+    if not descriptor:
+        return 0
+    world = max(1, int(descriptor.get("world", 1)))
+    sharded = descriptor.get("kind") == "sharded"
+    padded_elems = sum(
+        int(padded) for _, padded in descriptor.get("buckets", [])
+    )
+    bucket_bytes = padded_elems * 4
+    if sharded:
+        bucket_bytes //= world
+    residual_bytes = 0
+    if descriptor.get("error_feedback"):
+        if sharded:
+            # the sharded residual lives in bucket layout: 1/world of the
+            # padded flat buffer per device
+            residual_bytes = padded_elems * 4 // world
+        else:
+            # replicated: one full fp32 residual per leaf on every device
+            residual_bytes = sum(
+                int(n) for n in descriptor.get("leaf_sizes", [])
+            ) * 4
+    return int(bucket_bytes + residual_bytes)
+
+
+class MemoryObservatory:
+    """The HBM capacity ledger of one run (train facade or serving
+    engine).  Owners register subsystem components as zero-arg byte
+    callables (:meth:`set_component`) and feed the dispatch funnels
+    through :meth:`note_program`; the telemetry pipeline reads
+    :meth:`event_fields` / :meth:`refresh_gauges`, and
+    ``Stoke.memory_summary`` / ``ServingEngine.summary()`` read
+    :meth:`summary`."""
+
+    def __init__(self, cfg, registry):
+        self.cfg = cfg
+        self.registry = registry
+        #: component name -> zero-arg callable returning live bytes
+        self._components: Dict[str, Callable[[], int]] = {}
+        #: per-program memory_analysis component stats (program -> dict)
+        self.program_mem: Dict[str, Dict[str, float]] = {}
+        #: serve KV headroom forecast, set by the owning ServingEngine
+        self._serve_headroom: Optional[float] = None
+        self.cache: Optional[CostCardCache] = None
+        if cfg.program_peaks:
+            # the PR-18 cost-card machinery with the memory_analysis leg
+            # armed: one compile per distinct program signature attaches
+            # the executable's argument/output/temp/generated-code bytes
+            self.cache = CostCardCache(
+                registry, counter_prefix="mem/cost", memory_analysis=True
+            )
+        #: pre-flight verdicts, by context ("build"/"serve") — test hook
+        #: and post-mortem record of what the forecast said before the
+        #: first dispatch
+        self.preflights: Dict[str, Dict[str, Any]] = {}
+
+    # ------------------------------ ledger ------------------------------ #
+
+    def set_component(self, name: str, fn: Callable[[], int]) -> None:
+        """Register one subsystem's live-bytes callable.  ``name`` must
+        be a :data:`LEDGER_COMPONENTS` member — the JSONL field set is a
+        wire format, not an open namespace."""
+        if name not in LEDGER_COMPONENTS:
+            raise ValueError(
+                f"unknown memory-ledger component {name!r} "
+                f"(known: {LEDGER_COMPONENTS})"
+            )
+        self._components[name] = fn
+
+    def ledger(self) -> Dict[str, Optional[int]]:
+        """The per-subsystem resident ledger: bytes per registered
+        component (None for unregistered ones — absent subsystems are
+        distinguishable from empty ones) plus ``resident`` = the EXACT
+        sum of the registered components."""
+        out: Dict[str, Optional[int]] = {}
+        resident = 0
+        for name in LEDGER_COMPONENTS:
+            fn = self._components.get(name)
+            if fn is None:
+                out[name] = None
+                continue
+            try:
+                nbytes = int(fn())
+            except Exception:
+                # a racing subsystem (e.g. a snapshot resolving mid-read)
+                # must never kill telemetry; 0 this window, live next
+                nbytes = 0
+            out[name] = nbytes
+            resident += nbytes
+        out["resident"] = resident
+        return out
+
+    def resident_bytes(self) -> int:
+        return self.ledger()["resident"]
+
+    # ------------------------- program peaks ---------------------------- #
+
+    def note_program(self, program: str, fn, args: tuple, sig) -> None:
+        """Per-dispatch hook (both engines' funnels): first call per
+        (program, signature) pays the ``memory_analysis`` compile; every
+        call keeps the program's latest component stats."""
+        if self.cache is None:
+            return
+        card = self.cache.note_dispatch(
+            (program, sig), program, fn, args, steps=0
+        )
+        if card is not None and card.mem_stats:
+            self.program_mem[program] = card.mem_stats
+
+    def temp_peak_bytes(self) -> Optional[float]:
+        """Max temp-buffer bytes over every analyzed program — the
+        transient the OOM pre-flight stacks on top of the resident set
+        (programs never run concurrently per device; max, not sum)."""
+        temps = [
+            m.get("temp_bytes")
+            for m in self.program_mem.values()
+            if m.get("temp_bytes") is not None
+        ]
+        return max(temps) if temps else None
+
+    # --------------------------- capacity ------------------------------- #
+
+    def capacity_bytes(self) -> Optional[int]:
+        """Device HBM capacity: the ``MemoryConfig.capacity_bytes``
+        override when set (planning/test runs on capacity-blind
+        backends), else the live ``memory_stats()`` bytes_limit, else
+        None (the CPU simulator reports nothing)."""
+        if self.cfg.capacity_bytes is not None:
+            return int(self.cfg.capacity_bytes)
+        stats = hbm_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+        return None
+
+    def predicted_peak_bytes(self) -> int:
+        return int(self.resident_bytes() + (self.temp_peak_bytes() or 0))
+
+    def headroom_bytes(self) -> Optional[int]:
+        cap = self.capacity_bytes()
+        if cap is None:
+            return None
+        return int(cap - self.predicted_peak_bytes())
+
+    def unattributed_bytes(self) -> Optional[int]:
+        """Live ``memory_stats()`` bytes-in-use minus the analytic
+        resident total — the reconciliation gauge (None on backends
+        without stats).  A growing positive gap is fragmentation or an
+        unledgered subsystem; a negative one means something ledgered
+        was freed."""
+        stats = hbm_stats()
+        if not stats or stats.get("bytes_in_use") is None:
+            return None
+        return int(stats["bytes_in_use"] - self.resident_bytes())
+
+    # --------------------------- pre-flight ----------------------------- #
+
+    def preflight(self, context: str = "build") -> Dict[str, Any]:
+        """The OOM pre-flight: predicted peak vs capacity, run once at
+        ``build()``/``serve()`` BEFORE the first dispatch.  Fires a
+        warning naming the largest contributors and their remedies when
+        the prediction crosses ``oom_margin_frac`` of capacity; silent
+        (and recorded as such) otherwise or when no capacity is known."""
+        ledger = self.ledger()
+        resident = ledger["resident"]
+        temp = self.temp_peak_bytes()
+        predicted = int(resident + (temp or 0))
+        capacity = self.capacity_bytes()
+        contributors = sorted(
+            (
+                (name, nbytes)
+                for name, nbytes in ledger.items()
+                if name in LEDGER_COMPONENTS and nbytes
+            ),
+            key=lambda kv: kv[1],
+            reverse=True,
+        )
+        verdict: Dict[str, Any] = {
+            "context": context,
+            "fired": False,
+            "resident_bytes": resident,
+            "temp_peak_bytes": temp,
+            "predicted_peak_bytes": predicted,
+            "capacity_bytes": capacity,
+            "contributors": contributors,
+        }
+        if (
+            self.cfg.preflight
+            and capacity is not None
+            and predicted > self.cfg.oom_margin_frac * capacity
+        ):
+            verdict["fired"] = True
+            top = "; ".join(
+                f"{name}={nbytes / 2**20:.1f} MiB "
+                f"(remedy: {_COMPONENT_REMEDIES[name]})"
+                for name, nbytes in contributors[:3]
+            ) or "no ledgered components"
+            warnings.warn(
+                f"Stoke -- OOM pre-flight at {context}: predicted peak "
+                f"{predicted / 2**20:.1f} MiB "
+                f"(resident {resident / 2**20:.1f} MiB + program temp "
+                f"{(temp or 0) / 2**20:.1f} MiB) exceeds "
+                f"{self.cfg.oom_margin_frac:.0%} of the "
+                f"{capacity / 2**20:.1f} MiB device capacity.  "
+                f"Largest contributors: {top}"
+            )
+        self.preflights[context] = verdict
+        return verdict
+
+    # ------------------------- serve headroom --------------------------- #
+
+    def note_serve_headroom(self, headroom_bytes: Optional[float]) -> None:
+        """The owning ServingEngine's KV headroom forecast (free-pool
+        bytes minus worst-case blocks-to-completion of every in-flight
+        request); refreshed at the engine's gauge cadence."""
+        self._serve_headroom = headroom_bytes
+
+    def serve_event_fields(self) -> Dict[str, Any]:
+        """The conditional serve-record field this observatory adds
+        (merged into the engine's serve dict beside the SLO/cost
+        blocks)."""
+        return {"serve/mem_headroom_bytes": self._serve_headroom}
+
+    # ----------------------------- gauges ------------------------------- #
+
+    def refresh_gauges(self) -> None:
+        """Publish the ledger + forecast gauges (telemetry cadence)."""
+        reg = self.registry
+        for name, v in self.event_fields().items():
+            if v is not None:
+                reg.gauge(name).set(v)
+        if self._serve_headroom is not None:
+            reg.gauge("serve/mem_headroom_bytes").set(self._serve_headroom)
+
+    # --------------------------- JSONL fields --------------------------- #
+
+    def event_fields(self) -> Dict[str, Any]:
+        """The conditional ``mem/*`` block of one JSONL record — only
+        runs constructed with a ``MemoryConfig`` carry an observatory at
+        all, so unconfigured records stay byte-identical to pre-ISSUE-19
+        ones (``build_step_event`` honors the omission)."""
+        ledger = self.ledger()
+        out: Dict[str, Any] = {}
+        out["mem/params_bytes"] = ledger["params"]
+        out["mem/opt_state_bytes"] = ledger["opt_state"]
+        out["mem/transport_bytes"] = ledger["transport"]
+        out["mem/kv_cache_bytes"] = ledger["kv_cache"]
+        out["mem/snapshot_bytes"] = ledger["snapshot"]
+        out["mem/resident_bytes"] = ledger["resident"]
+        out["mem/temp_peak_bytes"] = self.temp_peak_bytes()
+        out["mem/predicted_peak_bytes"] = self.predicted_peak_bytes()
+        out["mem/capacity_bytes"] = self.capacity_bytes()
+        out["mem/headroom_bytes"] = self.headroom_bytes()
+        out["mem/unattributed_bytes"] = self.unattributed_bytes()
+        return out
+
+    # ----------------------------- summary ------------------------------ #
+
+    def summary(self) -> Dict[str, Any]:
+        """The memory block of ``Stoke.memory_summary()`` /
+        ``ServingEngine.summary()``: subsystems ranked by bytes, the
+        recombining resident total, per-program memory cards, and the
+        pre-flight verdicts."""
+        ledger = self.ledger()
+        ranked = sorted(
+            (
+                (name, nbytes)
+                for name, nbytes in ledger.items()
+                if name in LEDGER_COMPONENTS and nbytes is not None
+            ),
+            key=lambda kv: kv[1],
+            reverse=True,
+        )
+        return {
+            "active": True,
+            "components": {name: nbytes for name, nbytes in ranked},
+            "resident_bytes": ledger["resident"],
+            "temp_peak_bytes": self.temp_peak_bytes(),
+            "predicted_peak_bytes": self.predicted_peak_bytes(),
+            "capacity_bytes": self.capacity_bytes(),
+            "headroom_bytes": self.headroom_bytes(),
+            "unattributed_bytes": self.unattributed_bytes(),
+            "serve_headroom_bytes": self._serve_headroom,
+            "programs": {
+                program: dict(stats)
+                for program, stats in sorted(self.program_mem.items())
+            },
+            "preflights": dict(self.preflights),
+        }

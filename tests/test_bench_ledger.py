@@ -399,6 +399,78 @@ def test_emit_persisted_cost_columns_ride_stale_emit(ledger, capsys):
     assert out["attainable_tpot_s"] == 0.0021
 
 
+def test_emit_persisted_memory_guard_is_symmetric(ledger, capsys):
+    """ISSUE 19 satellite: the memory config key follows the
+    serve_speculative pattern (on a key shared by train AND serve
+    records) — a ledger-armed capture is never substituted for a default
+    run, and a default (pre-ledger, keyless) record still satisfies a
+    default request."""
+    # direction 1: a memory-armed capture never satisfies a default run
+    bench.persist_result(
+        "resnet50_cifar10_train_throughput",
+        {"value": 9000.0, "date": "2026-08-07", "backend": "tpu",
+         "memory": True, "mem_resident_bytes": 2 ** 30,
+         "mem_temp_peak_bytes": 2 ** 28, "mem_headroom_frac": 0.41},
+    )
+    rc, out = _emit(
+        capsys, "resnet50_cifar10_train_throughput",
+        requested={"memory": False},
+    )
+    assert rc == 1
+    assert "memory" in out["error"]
+    # direction 2: a default (keyless) record never satisfies a --memory
+    # run
+    bench.persist_result(
+        "resnet50_cifar10_train_throughput",
+        {"value": 9500.0, "date": "2026-07-01", "backend": "tpu"},
+    )
+    rc, out = _emit(
+        capsys, "resnet50_cifar10_train_throughput",
+        requested={"memory": True},
+    )
+    assert rc == 1
+    assert "memory" in out["error"]
+    # and a legacy keyless record satisfies a default request (absent
+    # normalizes to False — pre-ISSUE-19 captures carried no ledger)
+    rc, out = _emit(
+        capsys, "resnet50_cifar10_train_throughput",
+        requested={"memory": False},
+    )
+    assert rc == 0 and out["value"] == 9500.0
+
+
+def test_emit_persisted_memory_columns_ride_stale_serve_emit(
+    ledger, capsys
+):
+    """ISSUE 19 satellite: a re-cited memory-armed serve capture carries
+    its ledger columns (mem_resident_bytes / mem_temp_peak_bytes /
+    mem_headroom_frac), so consumers of the stale number still see the
+    HBM footprint it measured."""
+    bench.persist_result(
+        "gpt_small_serve_throughput",
+        {"value": 1600.0, "unit": "tokens/sec", "date": "2026-08-07",
+         "backend": "tpu", "serve": True, "memory": True,
+         "mem_resident_bytes": 6600704, "mem_temp_peak_bytes": 2122144.0,
+         "mem_headroom_frac": 0.87},
+    )
+    rc, out = _emit(
+        capsys, "gpt_small_serve_throughput",
+        requested={"memory": True},
+    )
+    assert rc == 0
+    assert out["memory"] is True
+    assert out["mem_resident_bytes"] == 6600704
+    assert out["mem_temp_peak_bytes"] == 2122144.0
+    assert out["mem_headroom_frac"] == 0.87
+
+
+def test_memory_is_a_regression_config_key():
+    """A --memory capture running slower than a differently-configured
+    best is a cross-configuration comparison, never a like-for-like
+    regression alarm."""
+    assert "memory" in bench._REGRESSION_CONFIG_KEYS
+
+
 def test_emit_persisted_cost_columns_absent_on_legacy_record(ledger, capsys):
     """The other direction of the ISSUE 18 guard: a pre-cost (legacy)
     serve record stays substitutable — the cost columns emit as None,

@@ -1,0 +1,713 @@
+"""HBM capacity observatory tests (ISSUE 19).
+
+The contract under test: with a ``MemoryConfig``, the analytic
+per-subsystem resident ledger (params, optimizer state, grad-transport
+buckets + error-feedback residual, serving KV pool, staged-snapshot
+buffers) recombines EXACTLY into the reported resident total — across
+all four step APIs on the train facade and on a serving engine, on the
+8-device CPU mesh — with the sharded (PR-8) vs replicated (PR-2)
+transports ledgering different, correct per-shard EF-residual bytes.
+Per-program ``memory_analysis`` temp/peak bytes feed the OOM pre-flight
+(fires naming contributors + remedies at an artificially small capacity,
+silent at a real one) and the ``audit-memory-drift`` gate (both
+directions vs the committed manifest, note-not-finding on geometry
+mismatch).  Default-OFF discipline: without the config no observatory is
+constructed, records carry zero ``mem/*`` fields, dispatch counts are
+equal, and the compiled programs are HLO bit-identical.
+"""
+
+import json
+import os
+import warnings
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from stoke_tpu import (
+    CommConfig,
+    MemoryConfig,
+    OSSConfig,
+    SDDPConfig,
+    Stoke,
+    StokeOptimizer,
+    StokeStatus,
+    StokeValidationError,
+    TelemetryConfig,
+)
+from stoke_tpu import offload
+from stoke_tpu.models.gpt import GPT
+from stoke_tpu.serving import ServingEngine
+from stoke_tpu.configs import ServeConfig
+from stoke_tpu.telemetry.events import read_step_events
+from stoke_tpu.telemetry.memory import (
+    LEDGER_COMPONENTS,
+    MEM_FIELDS,
+    MemoryObservatory,
+    transport_resident_bytes,
+    tree_resident_bytes,
+)
+from stoke_tpu.utils import init_module
+
+pytestmark = [pytest.mark.telemetry, pytest.mark.memory]
+
+IN, OUT = 16, 8
+VOCAB = 257
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_MANIFEST = os.path.join(
+    _REPO, "stoke_tpu", "analysis", "manifests", "program_memory.json"
+)
+
+
+def _make(tmp_path, tag, *, memory=True, comm=False, sddp=False,
+          mem_cfg=None, bpd=4):
+    tdir = str(tmp_path / tag)
+    cfgs = [
+        TelemetryConfig(
+            output_dir=tdir, log_every_n_steps=1, prometheus=False,
+            tensorboard=False, sample_device_time=False, track_hbm=False,
+        )
+    ]
+    if memory:
+        cfgs.append(mem_cfg or MemoryConfig())
+    if comm:
+        cfgs.append(CommConfig(dtype="int8", stochastic_rounding=False))
+    if sddp:
+        # shard even the tiny test leaves (defaults replicate < 1k elems)
+        cfgs.append(OSSConfig(min_shard_size=1))
+        cfgs.append(SDDPConfig(min_shard_size=1))
+    s = Stoke(
+        model=lambda p, x: x @ p["w1"] @ p["w2"],
+        optimizer=StokeOptimizer(
+            optimizer=optax.sgd,
+            optimizer_kwargs={"learning_rate": 0.05, "momentum": 0.9},
+        ),
+        loss=lambda o, y: ((o - y) ** 2).mean(),
+        params={
+            "w1": np.ones((IN, IN), np.float32) * 0.1,
+            "w2": np.ones((IN, OUT), np.float32) * 0.1,
+        },
+        batch_size_per_device=bpd,
+        distributed="dp" if comm else None,
+        oss=sddp,
+        sddp=sddp,
+        configs=cfgs,
+        verbose=False,
+    )
+    return s, tdir
+
+
+def _batch(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, IN)).astype(np.float32)
+    y = np.zeros((n, OUT), np.float32)
+    return x, y
+
+
+# --------------------------------------------------------------------------- #
+# analytic byte arithmetic (unit)
+# --------------------------------------------------------------------------- #
+
+
+def test_tree_resident_bytes_counts_local_shards(devices):
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    tree = {
+        "a": np.zeros((4, 3), np.float32),   # 48 B
+        "b": np.zeros((5,), np.int8),        # 5 B
+        "c": 7,                              # non-array leaf: skipped
+    }
+    assert tree_resident_bytes(tree) == 48 + 5
+    assert tree_resident_bytes({}) == 0
+    # a mesh-sharded leaf contributes its LOCAL shard, not the global
+    mesh = Mesh(np.array(devices), ("data",))
+    x = jax.device_put(
+        jnp.zeros((8, 4), jnp.float32), NamedSharding(mesh, P("data"))
+    )
+    assert tree_resident_bytes({"x": x}) == 8 * 4 * 4 // 8
+    # a replicated placement keeps the full shape
+    r = jax.device_put(
+        jnp.zeros((8, 4), jnp.float32), NamedSharding(mesh, P())
+    )
+    assert tree_resident_bytes({"r": r}) == 8 * 4 * 4
+
+
+def test_transport_resident_bytes_per_layout():
+    assert transport_resident_bytes(None) == 0
+    assert transport_resident_bytes({}) == 0
+    repl = {
+        "kind": "replicated", "world": 8, "error_feedback": True,
+        "leaf_sizes": [20, 5], "buckets": [[25, 512]],
+    }
+    # replicated: full fp32 buckets + one full per-leaf residual
+    assert transport_resident_bytes(repl) == 512 * 4 + 25 * 4
+    sh = dict(repl, kind="sharded")
+    # sharded: 1/world of the padded buffer for buckets AND residual
+    assert transport_resident_bytes(sh) == 512 * 4 // 8 + 512 * 4 // 8
+    assert transport_resident_bytes(sh) < transport_resident_bytes(repl)
+    # without error feedback only the buckets remain
+    assert transport_resident_bytes(
+        dict(repl, error_feedback=False)
+    ) == 512 * 4
+    assert transport_resident_bytes(
+        dict(sh, error_feedback=False)
+    ) == 512 * 4 // 8
+
+
+def test_observatory_rejects_unknown_component(tmp_path):
+    from stoke_tpu.telemetry.registry import MetricsRegistry
+
+    obs = MemoryObservatory(MemoryConfig(), MetricsRegistry())
+    with pytest.raises(ValueError, match="unknown memory-ledger"):
+        obs.set_component("activations", lambda: 0)
+    # an unregistered component reads None, never 0 — absent subsystems
+    # stay distinguishable from empty ones
+    ledger = obs.ledger()
+    assert all(ledger[name] is None for name in LEDGER_COMPONENTS)
+    assert ledger["resident"] == 0
+
+
+# --------------------------------------------------------------------------- #
+# the recombination acceptance: all four step APIs + serve
+# --------------------------------------------------------------------------- #
+
+
+def test_ledger_recombines_across_all_four_step_apis(tmp_path):
+    """Every JSONL record's component fields sum EXACTLY to its resident
+    total, over a trace exercising train_step, the 4-call sequence,
+    train_step_window, and train_steps; params/opt_state match an
+    independent tree_resident_bytes recomputation."""
+    s, tdir = _make(tmp_path, "recombine")
+    x, y = _batch()
+    s.train_step(x, (y,))
+    out = s.model(x)
+    l = s.loss(out, y)
+    s.backward(l)
+    s.step()
+    s.train_step_window(x[None], (y[None],))
+    s.train_steps(np.stack([x, x]), (np.stack([y, y]),))
+
+    assert s.memory is not None
+    summ = s.memory_summary
+    assert summ["active"] is True
+    assert summ["resident_bytes"] == sum(summ["components"].values())
+    # independent recomputation of the two tree-backed components
+    assert summ["components"]["params"] == tree_resident_bytes(s._variables)
+    assert summ["components"]["opt_state"] == tree_resident_bytes(
+        s._opt_state
+    )
+    # step programs were analyzed: a positive temp peak and the
+    # predicted-peak identity
+    assert summ["temp_peak_bytes"] and summ["temp_peak_bytes"] > 0
+    assert summ["predicted_peak_bytes"] == (
+        summ["resident_bytes"] + summ["temp_peak_bytes"]
+    )
+    assert summ["programs"]
+    assert all(
+        m.get("peak_bytes", 0) > 0 for m in summ["programs"].values()
+    )
+
+    s.close_telemetry()
+    records = read_step_events(os.path.join(tdir, "steps.jsonl"))
+    assert len(records) >= 4  # one per logged step across the four APIs
+    for rec in records:
+        parts = [
+            rec[f"mem/{name}_bytes"]
+            for name in LEDGER_COMPONENTS
+            if rec.get(f"mem/{name}_bytes") is not None
+        ]
+        assert parts and sum(parts) == rec["mem/resident_bytes"]
+        # the train facade never ledgers a KV pool
+        assert rec["mem/kv_cache_bytes"] is None
+        assert rec["mem/predicted_peak_bytes"] == (
+            rec["mem/resident_bytes"] + (rec["mem/temp_peak_bytes"] or 0)
+        )
+        # CPU simulator: no capacity, no headroom, no reconciliation
+        assert rec["mem/capacity_bytes"] is None
+        assert rec["mem/headroom_bytes"] is None
+        assert rec["mem/unattributed_bytes"] is None
+
+
+def test_sharded_vs_replicated_transport_resident_bytes(tmp_path):
+    """The topology-dependent resident set the analytic ledger exists to
+    pin: the PR-8 sharded transport ledgers 1/world of the buckets + EF
+    residual per device, the PR-2 replicated one a full copy — both
+    exactly reproducible from the live layout descriptor."""
+    x, y = _batch()
+    sizes = {}
+    for tag, sddp in (("repl", False), ("shard", True)):
+        s, _ = _make(tmp_path, tag, comm=True, sddp=sddp)
+        s.train_step(x, (y,))
+        desc = s._engine.transport.layout_descriptor(
+            s._variables["params"]
+        )
+        assert desc is not None and desc["error_feedback"] is True
+        assert desc["kind"] == ("sharded" if sddp else "replicated")
+        ledgered = s.memory_summary["components"]["transport"]
+        assert ledgered == transport_resident_bytes(desc)
+        # hand-recomputed from the descriptor's own bucket table
+        padded = sum(p for _, p in desc["buckets"])
+        if sddp:
+            expect = padded * 4 // desc["world"] * 2
+        else:
+            expect = padded * 4 + sum(desc["leaf_sizes"]) * 4
+        assert ledgered == expect > 0
+        sizes[tag] = ledgered
+        s.close_telemetry()
+    assert sizes["shard"] < sizes["repl"]
+
+
+# --------------------------------------------------------------------------- #
+# serving engine
+# --------------------------------------------------------------------------- #
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    model = GPT(
+        vocab_size=VOCAB, size_name="tiny", max_len=128, dropout_rate=0.0
+    )
+    variables = init_module(
+        model, jax.random.PRNGKey(0), np.zeros((1, 8), np.int32),
+        train=False,
+    )
+    return model, variables["params"]
+
+
+def _cfg(**kw):
+    base = dict(
+        max_seqs=4, kv_block_size=8, max_seq_len=64, max_new_tokens=16,
+        prefill_pad_multiple=16,
+    )
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _gen(eng, prompts, n):
+    rids = [eng.submit(np.asarray(p, np.int32), n) for p in prompts]
+    eng.run()
+    return [list(eng.scheduler.finished[r].tokens) for r in rids]
+
+
+def _jsonl_record(eng):
+    """The serve JSONL record exactly as emit_record builds it (without
+    attaching a full telemetry pipeline; the test_serving_slo idiom)."""
+    from stoke_tpu.telemetry.events import build_step_event
+
+    mem = eng._memory
+    return build_step_event(
+        ts=0.0, step=1, rank=0, window_steps=1, host_dispatch_s=0.0,
+        loader_wait_s=0.0, samples_total=1.0, compiles_total=0,
+        recompiles=0, compile_time_s=0.0,
+        serve={
+            **eng.metrics.event_fields(),
+            **(mem.serve_event_fields() if mem is not None else {}),
+        },
+        **({"memory": mem.event_fields()} if mem is not None else {}),
+    )
+
+
+@pytest.fixture(scope="module")
+def mem_run(gpt):
+    """ONE memory-armed serve trace; the facets below assert against the
+    same run (engines compile once per module)."""
+    model, params = gpt
+    eng = ServingEngine(
+        model, params, _cfg(), memory=MemoryConfig()
+    )
+    prompts = [[5, 9, 3] * 4, [11, 2] * 6, [7] * 8, [1, 2, 3] * 4]
+    out = _gen(eng, prompts, 16)
+    eng._refresh_gauges()
+    return {"eng": eng, "out": out}
+
+
+def test_serve_ledger_recombines(mem_run):
+    eng = mem_run["eng"]
+    summ = eng.summary()["memory"]
+    assert summ["active"] is True
+    assert set(summ["components"]) == {"params", "kv_cache"}
+    assert summ["resident_bytes"] == sum(summ["components"].values())
+    assert summ["components"]["params"] == tree_resident_bytes(eng.qparams)
+    assert summ["components"]["kv_cache"] == eng.cache.nbytes
+    # the serve dispatch funnel fed the program cards
+    assert summ["programs"]
+    assert "serve_decode" in summ["programs"]
+    assert summ["temp_peak_bytes"] > 0
+    # the pre-flight ran at engine construction, before any dispatch
+    verdict = summ["preflights"]["serve"]
+    assert verdict["fired"] is False  # no capacity on the CPU simulator
+    assert dict(verdict["contributors"])["kv_cache"] == eng.cache.nbytes
+
+
+def test_serve_headroom_forecast(mem_run):
+    """Free-pool bytes minus the queue's worst-case block demand; the
+    drained engine's forecast is the whole free pool."""
+    eng = mem_run["eng"]
+    alloc = eng.allocator
+    bytes_per_block = eng.cache.nbytes / alloc.num_blocks
+    assert not eng.scheduler.queue
+    expect = alloc.free_blocks * bytes_per_block
+    assert eng._mem_headroom_bytes() == expect
+    rec = _jsonl_record(eng)
+    assert rec["serve/mem_headroom_bytes"] == expect
+    # the mem/* ledger block rides the same record
+    assert rec["mem/resident_bytes"] == (
+        rec["mem/params_bytes"] + rec["mem/kv_cache_bytes"]
+    )
+    assert rec["mem/opt_state_bytes"] is None  # no optimizer in serving
+    # gauges published at the engine cadence
+    reg = eng.metrics.registry
+    assert reg.gauge("mem/resident_bytes").value > 0
+    assert reg.gauge("serve/mem_headroom_bytes").value == expect
+
+
+# --------------------------------------------------------------------------- #
+# OOM pre-flight
+# --------------------------------------------------------------------------- #
+
+
+def test_preflight_fires_at_small_capacity(tmp_path):
+    """At an artificially small capacity the build-time pre-flight warns
+    BEFORE the first dispatch, naming the top contributors and their
+    remedies; the verdict is recorded for the post-mortem."""
+    with pytest.warns(UserWarning, match="OOM pre-flight at build"):
+        s, _ = _make(
+            tmp_path, "oom",
+            mem_cfg=MemoryConfig(capacity_bytes=1024),
+        )
+    verdict = s.memory.preflights["build"]
+    assert verdict["fired"] is True
+    assert verdict["capacity_bytes"] == 1024
+    assert verdict["predicted_peak_bytes"] > 1024
+    # contributors ranked largest-first; params dominates this model
+    assert verdict["contributors"][0][0] == "params"
+    s.close_telemetry()
+    # the warning text names the contributor and its remedy
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        s.memory.preflight("rerun")
+    (w,) = [c for c in caught if "OOM pre-flight" in str(c.message)]
+    assert "params" in str(w.message)
+    assert "shard parameters" in str(w.message)  # the remedy
+
+
+def test_preflight_silent_at_real_capacity_and_when_disabled(tmp_path):
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        s, _ = _make(
+            tmp_path, "roomy",
+            mem_cfg=MemoryConfig(capacity_bytes=10**12),
+        )
+    assert not [c for c in caught if "OOM pre-flight" in str(c.message)]
+    assert s.memory.preflights["build"]["fired"] is False
+    assert s.memory.headroom_bytes() > 0
+    s.close_telemetry()
+    # preflight=False keeps the ledger but never warns, even squeezed
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        s, _ = _make(
+            tmp_path, "muzzled",
+            mem_cfg=MemoryConfig(capacity_bytes=1024, preflight=False),
+        )
+    assert not [c for c in caught if "OOM pre-flight" in str(c.message)]
+    assert s.memory.preflights["build"]["fired"] is False
+    s.close_telemetry()
+
+
+def test_serve_preflight_fires_naming_kv_cache(gpt):
+    model, params = gpt
+    with pytest.warns(UserWarning, match="OOM pre-flight at serve"):
+        eng = ServingEngine(
+            model, params, _cfg(),
+            memory=MemoryConfig(capacity_bytes=1024),
+        )
+    verdict = eng._memory.preflights["serve"]
+    assert verdict["fired"] is True
+    assert {n for n, _ in verdict["contributors"]} == {
+        "params", "kv_cache"
+    }
+
+
+# --------------------------------------------------------------------------- #
+# default-OFF: no observatory, no fields, bit-identical programs
+# --------------------------------------------------------------------------- #
+
+
+def test_default_off_train_is_memory_free(tmp_path):
+    s, tdir = _make(tmp_path, "off", memory=False)
+    x, y = _batch()
+    s.train_step(x, (y,))
+    assert s.memory is None
+    assert s.memory_summary is None
+    s.close_telemetry()
+    rec = read_step_events(os.path.join(tdir, "steps.jsonl"))[-1]
+    assert not any(k.startswith("mem/") for k in rec)
+
+
+def test_default_off_fused_step_lowers_bit_identical(tmp_path):
+    """The observatory is host-side arithmetic only: facades with and
+    without it lower the SAME fused-step HLO (the test_numerics
+    discipline), and dispatch counts are equal over all four step APIs."""
+    from stoke_tpu.engine import DeferredOutput, is_deferred
+
+    x, y = _batch()
+
+    def fused_hlo(s):
+        margs = s._place_batch((x,))
+        sentinel = DeferredOutput(None, -1)
+        flat, treedef = jax.tree_util.tree_flatten(
+            ((sentinel, y), {}), is_leaf=is_deferred
+        )
+        arrays = s._place_batch([l for l in flat if not is_deferred(l)])
+        deferred = tuple(
+            (i, l._path) for i, l in enumerate(flat) if is_deferred(l)
+        )
+        fn = s._engine._build_fused(treedef, deferred, True)
+        return fn.lower(
+            s._variables, s._opt_state, s._grad_buf, s._scaler_state,
+            s._comm_state, s._rng, margs, {}, arrays,
+        ).as_text()
+
+    def run(tag, memory):
+        s, _ = _make(tmp_path, tag, memory=memory)
+        hlo = fused_hlo(s)
+        s.train_step(x, (y,))
+        out = s.model(x)
+        l = s.loss(out, y)
+        s.backward(l)
+        s.step()
+        s.train_step_window(x[None], (y[None],))
+        s.train_steps(np.stack([x, x]), (np.stack([y, y]),))
+        n = s.dispatch_count
+        s.close_telemetry()
+        return hlo, n
+
+    hlo_on, n_on = run("hlo_on", True)
+    hlo_off, n_off = run("hlo_off", False)
+    assert hlo_on == hlo_off
+    assert n_on == n_off
+
+
+def test_default_off_serve_engine_is_memory_free(gpt):
+    model, params = gpt
+    eng_off = ServingEngine(model, params, _cfg())
+    assert eng_off._memory is None
+    assert eng_off.summary()["memory"] == {"active": False}
+    rec = _jsonl_record(eng_off)
+    assert not any(
+        k.startswith("mem/") or k == "serve/mem_headroom_bytes"
+        for k in rec
+    )
+    eng_on = ServingEngine(model, params, _cfg(), memory=MemoryConfig())
+
+    def decode_hlo(eng):
+        return jax.jit(eng._decode_jit).lower(
+            *eng._decode_baseline_args()
+        ).as_text()
+
+    assert decode_hlo(eng_off) == decode_hlo(eng_on)
+
+
+# --------------------------------------------------------------------------- #
+# JSONL wire block
+# --------------------------------------------------------------------------- #
+
+
+def test_event_fields_cover_the_pinned_wire_block(mem_run):
+    """``event_fields`` emits exactly the MEM_FIELDS block — which is
+    itself pinned append-only in wire_formats.json."""
+    fields = mem_run["eng"]._memory.event_fields()
+    assert set(fields) == set(MEM_FIELDS)
+    with open(
+        os.path.join(
+            _REPO, "stoke_tpu", "analysis", "manifests",
+            "wire_formats.json",
+        )
+    ) as f:
+        pinned = [
+            e for e in json.load(f)["wire_formats"]
+            if e["name"] == "MEM_FIELDS"
+        ]
+    assert len(pinned) == 1
+    assert tuple(pinned[0]["fields"]) == MEM_FIELDS
+
+
+# --------------------------------------------------------------------------- #
+# staged-snapshot component (offload.py)
+# --------------------------------------------------------------------------- #
+
+
+def test_staged_nbytes_tracks_inflight_snapshots(devices):
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    offload.drain_staged()
+    assert offload.staged_nbytes() == 0
+    mesh = Mesh(np.array(devices), ("data",))
+    x = jax.device_put(
+        jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+        NamedSharding(mesh, P("data")),
+    )
+    snap = offload.stage_tree({"a": x, "b": 7})
+    # the decoupling copies pin exactly the array's bytes (non-array
+    # leaves cost nothing)
+    assert offload.staged_nbytes() == 64 * 4
+    snap.resolve()
+    assert offload.staged_nbytes() == 0
+
+
+# --------------------------------------------------------------------------- #
+# status rules
+# --------------------------------------------------------------------------- #
+
+
+def test_status_rules(tmp_path):
+    tcfg = TelemetryConfig(output_dir=str(tmp_path / "t"), prometheus=False)
+    with pytest.raises(StokeValidationError, match="TelemetryConfig"):
+        StokeStatus(batch_size_per_device=1, configs=[MemoryConfig()])
+    with pytest.raises(StokeValidationError, match="oom_margin_frac"):
+        StokeStatus(
+            batch_size_per_device=1,
+            configs=[tcfg, MemoryConfig(oom_margin_frac=0.0)],
+        )
+    with pytest.raises(StokeValidationError, match="capacity_bytes"):
+        StokeStatus(
+            batch_size_per_device=1,
+            configs=[tcfg, MemoryConfig(capacity_bytes=-1)],
+        )
+    # the valid combination passes
+    StokeStatus(batch_size_per_device=1, configs=[tcfg, MemoryConfig()])
+
+
+# --------------------------------------------------------------------------- #
+# memory-drift gate
+# --------------------------------------------------------------------------- #
+
+
+def _serve_specs(mem_run):
+    return [
+        s for s in mem_run["eng"].audit_specs() if s.source == "serve"
+    ]
+
+
+def _mem_manifest_for(specs):
+    from stoke_tpu.analysis.program import spec_memory_entry
+
+    programs = {}
+    for s in specs:
+        if s.program in programs:
+            continue
+        entry = spec_memory_entry(s)
+        if entry is not None:
+            programs[s.program] = entry
+    return {"tolerance": 0.25, "programs": programs}
+
+
+def _drift_findings(rep):
+    return [f for f in rep.findings if f.rule == "audit-memory-drift"]
+
+
+def test_memory_drift_gate_clean_manifest_passes(mem_run):
+    from stoke_tpu.analysis.program import audit_program_specs
+
+    specs = _serve_specs(mem_run)
+    assert specs
+    rep = audit_program_specs(specs, mem_manifest=_mem_manifest_for(specs))
+    assert _drift_findings(rep) == []
+
+
+def test_memory_drift_gate_fires_both_directions(mem_run):
+    from stoke_tpu.analysis.program import audit_program_specs
+
+    specs = _serve_specs(mem_run)
+    manifest = _mem_manifest_for(specs)
+    prog = next(iter(manifest["programs"]))
+    bloat = json.loads(json.dumps(manifest))
+    bloat["programs"][prog]["peak_bytes"] *= 1.5  # pinned ABOVE measured
+    rep = audit_program_specs(specs, mem_manifest=bloat)
+    (f,) = _drift_findings(rep)
+    assert prog in f.message and "shrank" in f.message
+
+    slim = json.loads(json.dumps(manifest))
+    slim["programs"][prog]["temp_bytes"] /= 2.0  # pinned BELOW measured
+    rep = audit_program_specs(specs, mem_manifest=slim)
+    (f,) = _drift_findings(rep)
+    assert "grew" in f.message and "temp_bytes" in f.message
+    # a widened tolerance swallows the same deviation
+    rep = audit_program_specs(specs, mem_manifest=slim, mem_tolerance=2.0)
+    assert _drift_findings(rep) == []
+
+
+def test_memory_drift_gate_unpinned_and_sig_mismatch(mem_run):
+    from stoke_tpu.analysis.program import audit_program_specs
+
+    specs = _serve_specs(mem_run)
+    manifest = _mem_manifest_for(specs)
+    prog = next(iter(manifest["programs"]))
+    # an unpinned serve program is a finding (the gate must not silently
+    # skip new programs)
+    del manifest["programs"][prog]
+    rep = audit_program_specs(specs, mem_manifest=manifest)
+    (f,) = _drift_findings(rep)
+    assert prog in f.message and "--update-mem" in f.remedy
+    # a geometry-signature mismatch is NOT comparable → note, no finding
+    manifest = _mem_manifest_for(specs)
+    manifest["programs"][prog]["sig"] = "0" * 16
+    manifest["programs"][prog]["peak_bytes"] *= 100.0
+    rep = audit_program_specs(specs, mem_manifest=manifest)
+    assert _drift_findings(rep) == []
+    assert any("signature" in n or "geometry" in n for n in rep.notes)
+    # no manifest at all → the gate notes itself unchecked
+    rep = audit_program_specs(specs)
+    assert _drift_findings(rep) == []
+    assert any("no program-memory manifest" in n for n in rep.notes)
+
+
+@pytest.mark.slow
+def test_stoke_lint_programs_cli_mem_drift_fixture(tmp_path):
+    """The CI gate end-to-end: ``stoke_lint.py --programs`` against a
+    doctored memory manifest (serve_decode's pinned temp bytes bloated
+    2x) exits 1 with the audit-memory-drift finding printed; against the
+    committed manifests the tree passes clean."""
+    import subprocess
+    import sys
+
+    with open(_MANIFEST) as f:
+        manifest = json.load(f)
+    manifest["programs"]["serve_decode"]["temp_bytes"] *= 2.0
+    doctored = tmp_path / "doctored_memory.json"
+    doctored.write_text(json.dumps(manifest))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "stoke_lint.py"),
+         "--programs", "--mem-manifest", str(doctored)],
+        capture_output=True, text=True, cwd=_REPO, env=env, timeout=600,
+    )
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "audit-memory-drift" in out.stdout
+    assert "serve_decode" in out.stdout and "shrank" in out.stdout
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "stoke_lint.py"),
+         "--programs"],
+        capture_output=True, text=True, cwd=_REPO, env=env, timeout=600,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "0 finding(s)" in out.stdout
+
+
+def test_committed_memory_manifest_pins_all_serve_programs():
+    with open(_MANIFEST) as f:
+        manifest = json.load(f)
+    assert set(manifest["programs"]) == {
+        "serve_prefill", "serve_prefill_chunk",
+        "serve_prefill_chunk_packed", "serve_decode", "serve_verify",
+    }
+    assert manifest["tolerance"] == 0.25
+    for entry in manifest["programs"].values():
+        assert entry["temp_bytes"] > 0
+        assert entry["peak_bytes"] > entry["temp_bytes"]
+        assert len(entry["sig"]) == 16
+    assert "--update-mem" in " ".join(manifest["_comment"])

@@ -1181,12 +1181,14 @@ def test_serve_event_fields_match_schema():
     both.  The serve/slo_* fields (ISSUE 16) are the schema's nullable
     tail: SLOTracker emits them only once a deadline-tagged request
     exists — and the serve/spec_* fields (ISSUE 17) likewise appear only
-    on a speculative engine, and the serve/cost_* block (ISSUE 18) only
-    on a cost-instrumented one — so a plain ServeMetrics covers exactly
-    the non-SLO non-speculative non-cost slice, and enable_speculative()
-    grows the block by exactly SERVE_SPEC_FIELDS."""
+    on a speculative engine, the serve/cost_* block (ISSUE 18) only on a
+    cost-instrumented one, and the serve/mem_* headroom field (ISSUE 19)
+    only on a memory-ledgered one — so a plain ServeMetrics covers
+    exactly the non-SLO non-speculative non-cost non-memory slice, and
+    enable_speculative() grows the block by exactly SERVE_SPEC_FIELDS."""
     from stoke_tpu.telemetry.events import (
         SERVE_COST_FIELDS,
+        SERVE_MEM_FIELDS,
         SERVE_SLO_FIELDS,
         SERVE_SPEC_FIELDS,
         SERVE_STEP_FIELDS,
@@ -1202,6 +1204,7 @@ def test_serve_event_fields_match_schema():
         - set(SERVE_SLO_FIELDS)
         - set(SERVE_SPEC_FIELDS)
         - set(SERVE_COST_FIELDS)
+        - set(SERVE_MEM_FIELDS)
     )
     assert "serve/prefill_chunks" in fields
     assert "serve/sampled_tokens" in fields
