@@ -7,7 +7,7 @@
 CPU_ENV = env PYTHONPATH=$(CURDIR) JAX_PLATFORMS=cpu
 MESH_ENV = $(CPU_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-full test-fast test-telemetry test-collectives test-health test-attribution test-fleet test-autotune test-resilience test-zero test-serving test-serve-cost test-tracing test-numerics test-elastic test-analysis test-memory lint autotune-smoke dryrun bench-smoke telemetry-smoke serve-smoke tpu-probe
+.PHONY: test test-full test-fast test-telemetry test-collectives test-health test-attribution test-fleet test-autotune test-resilience test-zero test-serving test-serve-cost test-tracing test-numerics test-elastic test-analysis test-memory test-opsplane lint autotune-smoke dryrun bench-smoke telemetry-smoke serve-smoke tpu-probe
 
 lint:            ## static analysis (ISSUE 15): invariant linter (jax-free), program auditor over the lowered step/serve programs, + generated-api drift check; CI runs this before pytest
 	python scripts/stoke_lint.py
@@ -70,6 +70,9 @@ test-analysis:   ## static-analysis tests only (invariant linter rules/waivers/m
 
 test-memory:     ## HBM-capacity-observatory tests only (ledger recombination/OOM pre-flight/memory-drift gate)
 	$(MESH_ENV) python -m pytest tests/ -x -q -m memory
+
+test-opsplane:   ## live-ops-plane tests only (default-OFF contract/endpoint schemas/healthz flip/capture budget)
+	$(MESH_ENV) python -m pytest tests/ -x -q -m opsplane
 
 serve-smoke:     ## CPU-safe serve smoke: traced chunked-prefill + top-p request end-to-end, then the Poisson trace arm (never touches the tunnel)
 	$(MESH_ENV) python scripts/telemetry_smoke.py --serve-only

@@ -130,6 +130,9 @@ _SERVE_KEY_DEFAULTS = {
     # pre-ISSUE-19 records (train AND serve — the key is shared) carried
     # no HBM capacity ledger
     "memory": False,
+    # pre-ISSUE-20 serve records ran with no ops plane attached (no
+    # scrape-under-load poller during the measured pass)
+    "serve_scrape": False,
 }
 
 
@@ -206,6 +209,8 @@ def _emit_persisted(metric: str, capture_error: str,
                         "serve_decode_kernel", "serve_prefill_chunk",
                         "serve_sampling", "serve_long_prompt",
                         "serve_priority_mix", "serve_speculative",
+                        "serve_scrape", "scrape_polls",
+                        "scrape_tpot_delta_frac", "scrape_overhead_ok",
                         "spec_accept_rate",
                         "accepted_tokens_per_dispatch",
                         "effective_tpot_s",
@@ -264,6 +269,7 @@ _REGRESSION_CONFIG_KEYS = (
     "numerics", "memory", "serve", "serve_quant", "serve_max_seqs",
     "serve_decode_kernel", "serve_prefill_chunk", "serve_sampling",
     "serve_long_prompt", "serve_priority_mix", "serve_speculative",
+    "serve_scrape",
 )
 
 
@@ -818,6 +824,65 @@ def _serve_bench(args, tiny: bool) -> int:
             ),
         }
 
+    # scrape-under-load guard (ISSUE 20): re-run the SAME trace with a
+    # live ops plane attached and a poller hammering /metrics + /statusz
+    # the whole pass; the per-emitted-token decode wall time vs the
+    # unscraped measured pass above is the scrape tax.  The claim is
+    # that GET handlers on a daemon thread never stall the decode loop.
+    scrape = bool(args.serve_scrape)
+    scrape_cols = {}
+    if scrape:
+        import threading
+        import urllib.request
+
+        from stoke_tpu.configs import OpsPlaneConfig
+        from stoke_tpu.telemetry.opsplane import OpsPlane
+
+        tpot_off = decode_wall_s / max(measured["tokens"], 1.0)
+        # the headline ttft/tpot percentiles describe the UNSCRAPED
+        # measured pass — snapshot them before the re-run refills the
+        # reservoirs under poller load
+        pct_unscraped = eng.metrics.latency_percentiles()
+        eng.metrics.reset_latency_reservoirs()
+        plane = OpsPlane(OpsPlaneConfig(port=0))
+        plane.attach_engine(eng)
+        plane.start()
+        stop = threading.Event()
+        polls = [0]
+
+        def _poll():
+            base = f"http://127.0.0.1:{plane.port}"
+            while not stop.is_set():
+                for ep in ("/metrics", "/statusz"):
+                    try:
+                        with urllib.request.urlopen(
+                            base + ep, timeout=5
+                        ) as r:
+                            r.read()
+                        polls[0] += 1
+                    except Exception:
+                        pass  # a torn scrape is the poller's problem
+
+        poller = threading.Thread(target=_poll, daemon=True)
+        poller.start()
+        ds_on0 = eng.metrics.decode_s.value
+        scraped = trace_pass(eng)
+        stop.set()
+        poller.join(timeout=5.0)
+        plane.close()
+        tpot_on = (eng.metrics.decode_s.value - ds_on0) / max(
+            scraped["tokens"], 1.0
+        )
+        delta = (tpot_on - tpot_off) / max(tpot_off, 1e-9)
+        scrape_cols = {
+            "scrape_polls": polls[0],
+            "scrape_tpot_delta_frac": round(delta, 4),
+            # the always-on-scrape claim: < 5% TPOT tax under a hostile
+            # poller (CPU captures are noisy; the on-chip capture is the
+            # binding verdict, same discipline as numerics_overhead_ok)
+            "scrape_overhead_ok": bool(delta < 0.05),
+        }
+
     stall_unchunked = None
     if long_arm:
         # the comparison leg: same trace, chunking disabled — its stall
@@ -826,7 +891,7 @@ def _serve_bench(args, tiny: bool) -> int:
         trace_pass(eng_off)  # warm
         stall_unchunked = trace_pass(eng_off)["tpot_stall_s"]
     tokens_per_s = measured["tokens"] / max(measured["wall_s"], 1e-9)
-    pct = eng.metrics.latency_percentiles()
+    pct = pct_unscraped if scrape else eng.metrics.latency_percentiles()
     result = {
         "metric": metric,
         "value": round(tokens_per_s, 2),
@@ -846,6 +911,7 @@ def _serve_bench(args, tiny: bool) -> int:
         "serve_long_prompt": True if long_arm else None,
         "serve_priority_mix": True if mix else None,
         "serve_speculative": True if spec else None,
+        "serve_scrape": True if scrape else None,
         **(
             {
                 "tpot_stall_chunked_s": round(measured["tpot_stall_s"], 6),
@@ -858,6 +924,7 @@ def _serve_bench(args, tiny: bool) -> int:
         **slo_cols,
         **cost_cols,
         **mem_cols,
+        **scrape_cols,
         "requests": n,
         "ttft_p50_s": round(pct["ttft_p50_s"], 6),
         "ttft_p99_s": round(pct["ttft_p99_s"], 6),
@@ -891,6 +958,7 @@ def _serve_bench(args, tiny: bool) -> int:
                 "serve_long_prompt": True if long_arm else None,
                 "serve_priority_mix": True if mix else None,
                 "serve_speculative": True if spec else None,
+                "serve_scrape": True if scrape else None,
                 "memory": True if args.memory else None,
             },
         )
@@ -922,6 +990,7 @@ def _serve_bench(args, tiny: bool) -> int:
                 "serve_long_prompt": True if long_arm else None,
                 "serve_priority_mix": True if mix else None,
                 "serve_speculative": True if spec else None,
+                "serve_scrape": True if scrape else None,
                 **(
                     {
                         "tpot_stall_chunked_s": result[
@@ -938,6 +1007,7 @@ def _serve_bench(args, tiny: bool) -> int:
                 **slo_cols,
                 **cost_cols,
                 **mem_cols,
+                **scrape_cols,
                 "requests": n,
                 "ttft_p50_s": result["ttft_p50_s"],
                 "ttft_p99_s": result["ttft_p99_s"],
@@ -1164,6 +1234,18 @@ def main():
                     "pair (fewer dispatches at equal emitted tokens is "
                     "what speculation buys).  A distinct configuration "
                     "for the stale-substitution and regression guards")
+    ap.add_argument("--serve-scrape", action="store_true",
+                    help="scrape-under-load arm (ISSUE 20): after the "
+                    "unscraped measured pass, re-run the same trace with "
+                    "a live ops plane bound on an ephemeral loopback port "
+                    "and a poller hammering /metrics + /statusz the whole "
+                    "pass; reports scrape_polls, scrape_tpot_delta_frac "
+                    "(per-emitted-token decode wall time vs the unscraped "
+                    "pass), and the scrape_overhead_ok (< 5%%) verdict.  "
+                    "The headline value and latency percentiles still "
+                    "describe the UNSCRAPED pass.  A distinct "
+                    "configuration for the stale-substitution and "
+                    "regression guards")
     ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
     tuned_rec = None

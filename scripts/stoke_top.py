@@ -1,0 +1,173 @@
+"""stoke_top: a terminal dashboard over the live ops plane (ISSUE 20).
+
+The ``top(1)`` of a stoke rank: polls ``/statusz`` + ``/requests`` on an
+:class:`~stoke_tpu.telemetry.opsplane.OpsPlane` endpoint and redraws one
+screen per interval — health verdict (the same 200/503 flip a load
+balancer drains on), goodput / MFU / HBM ledger from the training block,
+the serving engine's throughput + latency percentiles + SLO attainment,
+and the in-flight request table with per-request TTFT deadline headroom.
+Stdlib only (urllib + ANSI redraw); read-only against the plane, so it
+is always safe to point at a production rank.
+
+Usage (any host that can reach the plane's loopback/bound address):
+
+    python scripts/stoke_top.py [--url http://127.0.0.1:9200]
+        [--interval 2.0] [--once] [--no-clear]
+
+``--once`` prints a single frame and exits (scriptable: the smoke and
+docs examples use it); ``--interval`` is the redraw period in seconds.
+Exit 0 on a clean run, 1 when the endpoint never answered.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+
+def fetch(base: str, path: str, timeout: float = 5.0):
+    """One GET against the plane; error statuses are data (503 is the
+    drain verdict, not a failure of this tool)."""
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read().decode())
+        except ValueError:
+            return e.code, None
+    except (urllib.error.URLError, OSError, ValueError):
+        return None, None
+
+
+def _fmt(v, spec: str = "", none: str = "-") -> str:
+    if v is None:
+        return none
+    return format(v, spec) if spec else str(v)
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0:
+            return f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}TiB"
+
+
+def render(statusz: dict, requests: dict) -> str:
+    """One frame of the dashboard as a plain string (ANSI-free — the
+    caller owns the clear/redraw discipline, and tests diff the text)."""
+    lines = []
+    healthy = statusz.get("healthy")
+    verdict = (
+        "HEALTHY" if healthy
+        else f"HALTED ({statusz.get('halted')})" if healthy is False
+        else "unknown"
+    )
+    lines.append(
+        f"stoke_top — run={_fmt(statusz.get('run'))} "
+        f"rank={_fmt(statusz.get('rank'))} "
+        f"{_fmt(statusz.get('host'))}:{_fmt(statusz.get('port'))} "
+        f"up={_fmt(statusz.get('uptime_s'), '.0f')}s  [{verdict}]  "
+        f"anomalies={_fmt(statusz.get('anomalies'))}"
+    )
+
+    training = statusz.get("training") or {}
+    goodput = training.get("goodput") or {}
+    memory = training.get("memory") or {}
+    trace = training.get("trace") or {}
+    if training:
+        lines.append(
+            "train  "
+            f"goodput={_fmt(goodput.get('goodput_fraction'), '.1%')} "
+            f"windows={_fmt(goodput.get('windows'))} "
+            f"mfu={_fmt(goodput.get('mfu'), '.2e')} "
+            f"resident={_fmt_bytes(memory.get('resident_bytes'))} "
+            f"headroom={_fmt_bytes(memory.get('headroom_bytes'))} "
+            f"spans={_fmt(trace.get('spans'))}"
+        )
+
+    serving = statusz.get("serving") or {}
+    if serving:
+        slo = serving.get("slo") or {}
+        lines.append(
+            "serve  "
+            f"completed={_fmt(serving.get('completed'))} "
+            f"tokens={_fmt(serving.get('tokens_out'))} "
+            f"kv_occ={_fmt(serving.get('kv_block_occupancy'), '.1%')} "
+            f"ttft_p50={_fmt(serving.get('ttft_p50_s'), '.3f')}s "
+            f"tpot_p50={_fmt(serving.get('tpot_p50_s'), '.4f')}s "
+            f"slo_att={_fmt(slo.get('attainment'), '.1%')}"
+        )
+
+    rows = (requests or {}).get("requests") or []
+    lines.append(
+        f"requests ({len(rows)}"
+        f"{'+, truncated' if (requests or {}).get('truncated') else ''})"
+    )
+    if rows:
+        lines.append(
+            f"  {'rid':>6} {'prio':<12} {'state':<10} {'tok':>5} "
+            f"{'kvblk':>5} {'headroom_s':>10} {'age_s':>8}"
+        )
+        for r in rows:
+            lines.append(
+                f"  {_fmt(r.get('rid')):>6} "
+                f"{_fmt(r.get('priority')):<12} "
+                f"{_fmt(r.get('state')):<10} "
+                f"{_fmt(r.get('tokens_out')):>5} "
+                f"{_fmt(r.get('kv_blocks')):>5} "
+                f"{_fmt(r.get('slo_headroom_s'), '+.2f'):>10} "
+                f"{_fmt(r.get('age_s'), '.2f'):>8}"
+            )
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="terminal dashboard over a live stoke ops plane"
+    )
+    ap.add_argument("--url", default="http://127.0.0.1:9200",
+                    help="base URL of the rank's ops plane (multihost: "
+                    "rank r listens on port + r)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between redraws")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (scriptable)")
+    ap.add_argument("--no-clear", action="store_true",
+                    help="append frames instead of ANSI clear-and-redraw")
+    args = ap.parse_args()
+    base = args.url.rstrip("/")
+
+    seen = False
+    try:
+        while True:
+            _, statusz = fetch(base, "/statusz")
+            _, requests = fetch(base, "/requests")
+            if statusz is None:
+                frame = (
+                    f"stoke_top — {base}: no answer "
+                    f"(plane down or run finished)"
+                )
+            else:
+                seen = True
+                frame = render(statusz, requests or {})
+            if not args.no_clear and not args.once:
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(frame, flush=True)
+            if args.once:
+                return 0 if statusz is not None else 1
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0 if seen else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,7 +35,14 @@ JSONL ledger block asserted to recombine exactly (components sum to the
 resident total), the serve record carrying the KV headroom forecast and
 the engine-side ledger (quantized weight store + KV block pool), the
 ledger gauges in the Prometheus exposition, and every NON-armed run's
-records asserted memory-free (the default-OFF contract).  Prints the
+records asserted memory-free (the default-OFF contract); since ISSUE 20,
+the train window and the serve cycle both run with a live ops plane
+(``OpsPlaneConfig(port=0)``) — all six endpoints polled over real HTTP
+(``/metrics``, ``/healthz``, ``/statusz`` asserted to be EXACTLY the
+pinned ``STATUSZ_FIELDS`` tuple, ``/requests`` showing the serve
+cycle's queued table, ``/trace``, and a bounded ``/profile`` capture
+riding the attribution budget), plus a halting run proving the
+``/healthz`` 200→503 drain flip on an injected-NaN halt.  Prints the
 step record and a one-line verdict; exit 0 only when everything
 round-trips.
 """
@@ -54,6 +61,20 @@ def _trace_events(path):
     with open(path) as f:
         doc = json.load(f)
     return [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+
+
+def _ops_get(base, path):
+    """One real-HTTP GET against the live ops plane (ISSUE 20): returns
+    ``(status, body_text)`` — error statuses are data here, not
+    exceptions (a scraper reads 503 as the drain verdict)."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(base + path, timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
 
 
 def run_serve_cycle(sv_dir: str) -> dict:
@@ -82,6 +103,7 @@ def run_serve_cycle(sv_dir: str) -> dict:
     from stoke_tpu import (
         AttributionConfig,
         MemoryConfig,
+        OpsPlaneConfig,
         ServeConfig,
         Stoke,
         StokeOptimizer,
@@ -142,6 +164,9 @@ def run_serve_cycle(sv_dir: str) -> dict:
             # admission -> [chunks] -> prefill -> decode timelines are
             # parsed below
             TraceConfig(output_dir=os.path.join(sv_dir, "trace")),
+            # live ops plane (ISSUE 20): the serve cycle is scrapeable
+            # over real HTTP while it runs — ephemeral port, loopback
+            OpsPlaneConfig(port=0),
         ],
         verbose=False,
     )
@@ -172,6 +197,13 @@ def run_serve_cycle(sv_dir: str) -> dict:
     # accepts it; accept-rate > 0 asserted below)
     spec_prompt = np.asarray([5, 9, 3] * 4, np.int32)
     spec_rid = sv_eng.submit(spec_prompt, 8)
+    # live ops plane (ISSUE 20): five requests submitted, engine not yet
+    # run — the /requests table shows every one QUEUED, the SLO-tagged
+    # request carrying its remaining TTFT headroom (the drain/admission
+    # signal an operator reads before deciding where to send load)
+    op_base = f"http://127.0.0.1:{sv.opsplane.port}"
+    _, op_body = _ops_get(op_base, "/requests")
+    op_queued = json.loads(op_body)["requests"]
     sv_eng.run()
     # greedy-identity reference (ISSUE 17): the same greedy prompts
     # through a NON-speculative engine (same model / int8 weights — the
@@ -199,6 +231,22 @@ def run_serve_cycle(sv_dir: str) -> dict:
         == list(ref_eng.scheduler.finished[b].tokens)
         for a, b in list(zip(sv_rids, ref_rids))
         + [(spec_rid, ref_spec_rid)]
+    )
+    # live ops plane (ISSUE 20), post-drain: /statusz carries the full
+    # engine summary block (completed counts, occupancy back to zero)
+    _, op_body = _ops_get(op_base, "/statusz")
+    op_statusz = json.loads(op_body)
+    opsplane_ok = (
+        len(op_queued) == 5
+        and all(r["state"] == "queued" for r in op_queued)
+        and any(
+            r["rid"] == slo_rid
+            and r["priority"] == "interactive"
+            and (r["slo_headroom_s"] or 0) > 0
+            for r in op_queued
+        )
+        and (op_statusz.get("serving") or {}).get("completed") == 5
+        and (op_statusz.get("serving") or {}).get("kv_blocks_used") == 0
     )
     sv.close_telemetry()
     sv_rec = read_step_events(os.path.join(sv_dir, "steps.jsonl"))[-1]
@@ -315,9 +363,14 @@ def run_serve_cycle(sv_dir: str) -> dict:
         and "stoke_serve_cost_flops_total" in sv_prom
         # ISSUE 19: HBM-ledger wire evidence
         and mem_ok
+        # ISSUE 20: the in-flight request table and the post-drain
+        # engine summary, both read over real HTTP
+        and opsplane_ok
     )
     return {
         "ok": ok,
+        "opsplane_ok": opsplane_ok,
+        "opsplane_queued": len(op_queued),
         "mem_ok": mem_ok,
         "mem_summary": mem_summary,
         "cost_summary": cost_summary,
@@ -351,13 +404,17 @@ def main() -> int:
         AttributionConfig,
         FleetConfig,
         HealthConfig,
+        HealthHaltError,
         MemoryConfig,
         NumericsConfig,
+        OpsPlaneConfig,
+        ProfilerConfig,
         Stoke,
         StokeOptimizer,
         TelemetryConfig,
         TraceConfig,
     )
+    from stoke_tpu.telemetry.opsplane import STATUSZ_FIELDS
     from stoke_tpu.telemetry import read_step_events
     from stoke_tpu.utils.tb_writer import read_scalar_events
 
@@ -390,6 +447,12 @@ def main() -> int:
     # observatory rides the same window — the mem/* JSONL block, the
     # recombination identity, and the ledger gauges are asserted below
     mmcfg = MemoryConfig()
+    # live ops plane (ISSUE 20): the run is scrapeable WHILE it trains —
+    # all six endpoints are polled over real HTTP below; port 0 binds an
+    # ephemeral loopback port, and the ProfilerConfig trace_dir gives
+    # /profile somewhere to land its bounded manual capture
+    opcfg = OpsPlaneConfig(port=0)
+    pfcfg = ProfilerConfig(trace_dir=os.path.join(out_dir, "xprof"))
     stoke = Stoke(
         model=lambda p, x: x @ p["w"],
         optimizer=StokeOptimizer(
@@ -398,7 +461,7 @@ def main() -> int:
         loss=lambda o, y: ((o - y) ** 2).mean(),
         params={"w": np.ones((8, 4), np.float32)},
         batch_size_per_device=16,
-        configs=[cfg, hcfg, acfg, fcfg, trcfg, nmcfg, mmcfg],
+        configs=[cfg, hcfg, acfg, fcfg, trcfg, nmcfg, mmcfg, opcfg, pfcfg],
         verbose=False,
     )
     x = np.ones((16, 8), np.float32)
@@ -407,10 +470,86 @@ def main() -> int:
     # second step: the fleet view anchors its cadence on the first record
     # (warm-up discard) and closes its first exchange window on the next
     stoke.train_step(x, (y,))
+    # live ops plane (ISSUE 20): all six endpoints polled over real HTTP
+    # while the run is still alive — the exposition carries the same
+    # families the sink file gets at close, /statusz is EXACTLY the
+    # pinned field tuple (absent subsystems null, serving included: no
+    # engine in this run), /trace serves the live span ring, and
+    # /profile lands a bounded manual capture riding (and burning) the
+    # attribution capture budget
+    ops_base = f"http://127.0.0.1:{stoke.opsplane.port}"
+    _, ops_metrics = _ops_get(ops_base, "/metrics")
+    ops_hz_status, ops_hz_body = _ops_get(ops_base, "/healthz")
+    _, ops_statusz_body = _ops_get(ops_base, "/statusz")
+    _, ops_requests_body = _ops_get(ops_base, "/requests")
+    _, ops_trace_body = _ops_get(ops_base, "/trace")
+    ops_pf_status, ops_pf_body = _ops_get(ops_base, "/profile?seconds=0.05")
+    ops_statusz = json.loads(ops_statusz_body)
+    ops_profile = json.loads(ops_pf_body)
+    opsplane_train_ok = (
+        "stoke_jax_compiles_total" in ops_metrics
+        and ops_hz_status == 200
+        and json.loads(ops_hz_body)["ok"] is True
+        and tuple(ops_statusz) == STATUSZ_FIELDS
+        and ops_statusz["serving"] is None
+        and (ops_statusz["training"] or {}).get("goodput") is not None
+        and json.loads(ops_requests_body)["requests"] == []
+        and any(
+            e.get("name") == "stoke/dispatch"
+            for e in json.loads(ops_trace_body)
+        )
+        and ops_pf_status == 200
+        and os.path.isdir(ops_profile["trace_dir"])
+    )
     # forced post-mortem dump: the bundle a human reads after a crash —
     # exercised end-to-end so the crash path is proven BEFORE the crash
     bundle = stoke.health.dump("smoke")
     stoke.close_telemetry()
+
+    # the /healthz 200→503 flip (ISSUE 20): a second armed run halts on
+    # an injected NaN — and the plane keeps serving AFTER the halt (the
+    # socket is the load-balancer drain signal; it must not die with the
+    # step loop)
+    hz_dir = os.path.join(out_dir, "opsplane_halt")
+    hz_stoke = Stoke(
+        model=lambda p, x: x @ p["w"],
+        optimizer=StokeOptimizer(
+            optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.1}
+        ),
+        loss=lambda o, y: ((o - y) ** 2).mean(),
+        params={"w": np.ones((8, 4), np.float32)},
+        batch_size_per_device=16,
+        configs=[
+            TelemetryConfig(
+                output_dir=hz_dir, log_every_n_steps=1, prometheus=False,
+                tensorboard=False, sample_device_time=False, track_hbm=False,
+            ),
+            HealthConfig(nonfinite_action="halt", dump_signals=False),
+            OpsPlaneConfig(port=0),
+        ],
+        verbose=False,
+    )
+    hz_base = f"http://127.0.0.1:{hz_stoke.opsplane.port}"
+    hz_stoke.train_step(x, (y,))
+    hz_before, _ = _ops_get(hz_base, "/healthz")
+    xn = x.copy()
+    xn[:, 3] = np.nan
+    hz_halted = False
+    try:
+        hz_stoke.train_step(xn, (y,))
+    except HealthHaltError:
+        hz_halted = True
+    hz_after, hz_after_body = _ops_get(hz_base, "/healthz")
+    hz_verdict = json.loads(hz_after_body)
+    hz_stoke.close_telemetry()
+    opsplane_flip_ok = (
+        hz_before == 200
+        and hz_halted
+        and hz_after == 503
+        and hz_verdict["ok"] is False
+        and hz_verdict["halted"] == "nonfinite_grads"
+        and (hz_verdict["anomalies"] or 0) >= 1
+    )
 
     # persistent compile cache (ISSUE 6): one cached warm-start
     # end-to-end — a cold construction misses and persists, a second
@@ -839,6 +978,12 @@ def main() -> int:
         and not any(k.startswith("mem/") for k in zero_rec)
         and not any(k.startswith("mem/") for k in nm_rec)
         and not any(k.startswith("mem/") for k in nm_clean_rec)
+        # ISSUE 20: the live ops plane — six endpoints over real HTTP on
+        # the training run, the /healthz 200→503 drain flip on the
+        # injected-NaN halt, and the serve cycle's request table
+        and opsplane_train_ok
+        and opsplane_flip_ok
+        and sv_result["opsplane_ok"]
     )
     print(json.dumps({
         "telemetry_smoke": "ok" if ok else "FAILED",
@@ -895,6 +1040,17 @@ def main() -> int:
         "trace_train_spans": len(train_trace),
         "trace_serve_spans": len(serve_trace),
         "trace_requests": sorted(spans_by_rid),
+        "opsplane": (
+            "ok"
+            if opsplane_train_ok
+            and opsplane_flip_ok
+            and sv_result["opsplane_ok"]
+            else "FAILED"
+        ),
+        "opsplane_healthz_flip": [hz_before, hz_after],
+        "opsplane_halted": hz_verdict.get("halted"),
+        "opsplane_profile_dir": ops_profile.get("trace_dir"),
+        "opsplane_serve_queued": sv_result["opsplane_queued"],
     }))
     return 0 if ok else 1
 

@@ -29,6 +29,7 @@ from stoke_tpu.configs import (
     OffloadDiskConfig,
     OffloadOptimizerConfig,
     OffloadParamsConfig,
+    OpsPlaneConfig,
     OSSConfig,
     ParamNormalize,
     PartitionRulesConfig,
@@ -107,6 +108,7 @@ __all__ = [
     "OffloadDiskConfig",
     "OffloadOptimizerConfig",
     "OffloadParamsConfig",
+    "OpsPlaneConfig",
     "PartitionRulesConfig",
     "ActivationCheckpointingConfig",
     "CheckpointConfig",

@@ -1145,6 +1145,51 @@ class MemoryConfig:
 
 
 @dataclass
+class OpsPlaneConfig:
+    """Live ops plane (ISSUE 20 tentpole): a stdlib-only, read-only HTTP
+    observatory every rank can expose while it runs — ``/metrics``
+    (Prometheus exposition, the SAME renderer the file sink uses),
+    ``/healthz`` (200 ↔ 503 drain signal from the health monitor),
+    ``/statusz`` (pinned JSON: goodput + memory + trace + serving
+    summaries), ``/requests`` (in-flight serve table with SLO deadline
+    headroom), ``/trace`` (Perfetto span-ring snapshot), and
+    ``/profile?seconds=N`` (bounded on-demand xprof capture riding the
+    ``AttributionConfig.max_captures`` budget).
+
+    Requires a :class:`TelemetryConfig` (the plane serves the telemetry
+    registry and its sink labels; status-validated).  Default OFF —
+    without this config no thread starts and no socket binds, and with
+    it on the plane adds ZERO new JSONL fields and leaves dispatch
+    counts untouched: it only reads state other subsystems already keep
+    (docs/observability.md, "Live ops plane").
+
+    Attributes:
+        port: base TCP port; rank ``r`` binds ``port + r`` so colocated
+            multihost ranks never collide.  ``0`` binds an ephemeral
+            port (tests/benches; ``OpsPlane.port`` reports the bound
+            one).  Status-validated to 0..65535.
+        host: bind address — loopback by default so enabling the plane
+            never exposes a run to the network without an explicit
+            opt-in (``"0.0.0.0"`` for fleet scrapers behind a firewall).
+        profile_default_seconds: capture length when ``/profile`` is hit
+            without ``?seconds=`` (0 < default <= max;
+            status-validated).
+        profile_max_seconds: hard per-capture ceiling — a scraper asking
+            for more gets this clamp, and the capture COUNT is already
+            bounded by the attribution budget (status-validated > 0).
+        requests_limit: row cap of the ``/requests`` table (> 0;
+            status-validated); the response marks itself ``truncated``
+            when in-flight requests exceed it.
+    """
+
+    port: int = 9200
+    host: str = "127.0.0.1"
+    profile_default_seconds: float = 2.0
+    profile_max_seconds: float = 30.0
+    requests_limit: int = 256
+
+
+@dataclass
 class ResilienceConfig:
     """Pod-scale resilience (ISSUE 7 tentpole): preemption-aware emergency
     checkpointing, integrity-verified auto-resume with quarantine, and the
@@ -1576,6 +1621,7 @@ ALL_CONFIG_CLASSES: Tuple[type, ...] = (
     HealthConfig,
     MemoryConfig,
     NumericsConfig,
+    OpsPlaneConfig,
     ProfilerConfig,
     ResilienceConfig,
     ServeConfig,

@@ -740,6 +740,46 @@ class Stoke:
             # dispatched yet); warns BEFORE the first step can allocate
             obs.preflight("build")
 
+        # ----- live ops plane (ISSUE 20: scrapeable HTTP observatory —
+        #       /metrics via the sink's own renderer, /healthz drain
+        #       signal, pinned /statusz, /requests, /trace, bounded
+        #       /profile; default OFF — without an OpsPlaneConfig no
+        #       thread starts and no socket binds, and with one the
+        #       plane adds zero JSONL fields and zero dispatches) -----
+        self._opsplane = None
+        ocfg = st.opsplane_config
+        if ocfg is not None:
+            from stoke_tpu.telemetry.opsplane import OpsPlane
+
+            plane = OpsPlane(
+                ocfg, self._telemetry, rank=jax.process_index()
+            )
+            if self._health is not None:
+                plane.attach_health(self._health)
+            if self._tracer is not None:
+                plane.attach_tracer(self._tracer)
+            if self._attribution is not None:
+                plane.attach_attribution(self._attribution)
+            plane.attach_training(
+                goodput=(
+                    self._telemetry.goodput_summary
+                    if self._attribution is not None
+                    else None
+                ),
+                memory=(
+                    self._memory_obs.summary
+                    if self._memory_obs is not None
+                    else None
+                ),
+                trace_summary=(
+                    self._tracer.summary
+                    if self._tracer is not None
+                    else None
+                ),
+            )
+            plane.start()
+            self._opsplane = plane
+
         # ----- wall-clock breakdown (reference wall_clock_breakdown,
         #       configs.py:540; host-side dispatch times — device work is
         #       async, use profile_trace() for device timelines).  Backed by
@@ -1513,6 +1553,13 @@ class Stoke:
         return self._health
 
     @property
+    def opsplane(self):
+        """The run's live ops plane (None without an ``OpsPlaneConfig``)
+        — the bound HTTP observatory serving /metrics, /healthz,
+        /statusz, /requests, /trace and /profile for this rank."""
+        return self._opsplane
+
+    @property
     def attribution(self):
         """The run's step-time attribution monitor (None without an
         ``AttributionConfig``) — cost cards, live MFU gauges, goodput
@@ -1791,6 +1838,10 @@ class Stoke:
                 self._health.observe(self._optimizer_steps, None)
             except HealthHaltError:
                 pass
+        if self._opsplane is not None:
+            # unbind the socket FIRST: a scraper hitting a half-closed
+            # run would read torn summaries from closing subsystems
+            self._opsplane.close()
         if self._tracer is not None:
             # stop receiving other runs' spans, then export the final ring
             # (idempotent: a second close re-exports the same ring)
@@ -3149,6 +3200,10 @@ class Stoke:
             # and runs the serve-side OOM pre-flight at construction
             memory=self._status_obj.memory_config,
         )
+        if self._opsplane is not None:
+            # the live ops plane's /requests + /statusz serving block
+            # (ISSUE 20) follow the newest engine this facade built
+            self._opsplane.attach_engine(engine)
         if self._numerics is not None and engine.quant_errors_by_group:
             # per-layer dequant-error attribution (ISSUE 12): the engine
             # computed it once at quantize time; installing it here is

@@ -50,6 +50,7 @@ from stoke_tpu.configs import (
     OffloadDiskConfig,
     OffloadOptimizerConfig,
     OffloadParamsConfig,
+    OpsPlaneConfig,
     OSSConfig,
     PartitionRulesConfig,
     PrecisionConfig,
@@ -800,6 +801,59 @@ class StokeStatus:
                 )
             return False
 
+        def _opsplane_invalid(s):
+            """Ops-plane legality (ISSUE 20): the plane serves the
+            telemetry registry (so a TelemetryConfig is required), the
+            bind address/port must be usable, and the capture/table
+            bounds must actually bound (the silently-ignored-knob
+            anti-pattern: a zero requests_limit or an inverted
+            default-vs-max capture length would make an endpoint lie)."""
+            cfg = self._configs.get("OpsPlaneConfig")
+            if cfg is None:
+                return False
+            if "TelemetryConfig" not in self._configs:
+                return (
+                    "OpsPlaneConfig requires a TelemetryConfig — the "
+                    "plane serves the telemetry registry and reuses its "
+                    "Prometheus sink labels; add one or drop the config"
+                )
+            if not (0 <= cfg.port <= 65535):
+                return (
+                    f"OpsPlaneConfig.port must be in 0..65535 (0 binds "
+                    f"an ephemeral port; rank r binds port + r); got "
+                    f"{cfg.port}"
+                )
+            if not isinstance(cfg.host, str) or not cfg.host:
+                return (
+                    f"OpsPlaneConfig.host must be a non-empty bind "
+                    f"address (loopback '127.0.0.1' by default; "
+                    f"'0.0.0.0' to expose to fleet scrapers); got "
+                    f"{cfg.host!r}"
+                )
+            if cfg.profile_max_seconds <= 0:
+                return (
+                    f"OpsPlaneConfig.profile_max_seconds must be > 0 — "
+                    f"it is the hard per-capture ceiling /profile clamps "
+                    f"to; got {cfg.profile_max_seconds}"
+                )
+            if not (
+                0 < cfg.profile_default_seconds <= cfg.profile_max_seconds
+            ):
+                return (
+                    f"OpsPlaneConfig.profile_default_seconds must be in "
+                    f"(0, profile_max_seconds={cfg.profile_max_seconds}] "
+                    f"— /profile without ?seconds= uses it, and a "
+                    f"default above the ceiling would silently clamp; "
+                    f"got {cfg.profile_default_seconds}"
+                )
+            if cfg.requests_limit < 1:
+                return (
+                    f"OpsPlaneConfig.requests_limit must be >= 1 — it "
+                    f"caps the /requests table (the response marks "
+                    f"itself truncated past it); got {cfg.requests_limit}"
+                )
+            return False
+
         def _checkpoint_invalid(s):
             """Checkpoint-layout legality (ISSUE 14, extended by ISSUE
             15's knob-coverage lint): the periodic-save cadence must be
@@ -1486,6 +1540,10 @@ class StokeStatus:
                 "MemoryConfig is invalid for this combination",
             ),
             (
+                _opsplane_invalid,
+                "OpsPlaneConfig is invalid for this combination",
+            ),
+            (
                 _checkpoint_invalid,
                 "CheckpointConfig is invalid",
             ),
@@ -1762,6 +1820,13 @@ class StokeStatus:
         is opt-in; without it no ``mem/*`` field or gauge exists and the
         compiled programs are bit-identical to pre-ISSUE-19)."""
         return self._configs.get("MemoryConfig")
+
+    @property
+    def opsplane_config(self) -> Optional[OpsPlaneConfig]:
+        """None unless explicitly supplied (the live ops plane is
+        opt-in; without it no thread starts and no socket binds, and the
+        step paths are bit-identical to pre-ISSUE-20)."""
+        return self._configs.get("OpsPlaneConfig")
 
     @property
     def resilience_config(self) -> Optional[ResilienceConfig]:

@@ -46,6 +46,7 @@ analysis).
 from __future__ import annotations
 
 import threading
+import time
 import warnings
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
@@ -515,6 +516,12 @@ class AttributionMonitor:
         self._capture_stop_at: Optional[int] = None
         self._pending_trigger: Optional[Dict[str, Any]] = None
         self._capture_dirs: List[str] = []
+        # manual (ops-plane) captures run on scraper threads while the
+        # step path runs on_step: the lock orders start/stop transitions
+        # and the flag keeps on_step from closing a wall-clock-bounded
+        # manual window at its step-count boundary
+        self._capture_lock = threading.Lock()
+        self._manual_capture = False
         for b in GOODPUT_BUCKETS:
             registry.counter(
                 f"goodput/{b}_s_total", help=f"wall seconds: {b}"
@@ -732,25 +739,30 @@ class AttributionMonitor:
         safe = "".join(
             c if (c.isalnum() or c in "-_=.") else "-" for c in reason
         )[:48]
-        target = os.path.join(
-            self.trace_dir,
-            f"auto-capture-{self.captures + 1}-step{step}-{safe}",
-        )
-        try:
-            import jax
-
-            jax.profiler.start_trace(target)
-        except Exception as e:  # an unavailable profiler must not kill a run
-            warnings.warn(
-                f"Stoke -- attribution auto-capture failed to start: {e!r}"
+        with self._capture_lock:
+            if self._capturing:  # a manual capture raced in; defer
+                return
+            target = os.path.join(
+                self.trace_dir,
+                f"auto-capture-{self.captures + 1}-step{step}-{safe}",
             )
-            return
-        # count only traces that actually started: a failing profiler must
-        # neither burn the max_captures budget nor report phantom captures
-        self.captures += 1
-        self._capturing = True
-        self._capture_stop_at = step + max(1, self.cfg.capture_steps)
-        self._capture_dirs.append(target)
+            try:
+                import jax
+
+                jax.profiler.start_trace(target)
+            except Exception as e:  # unavailable profiler can't kill a run
+                warnings.warn(
+                    f"Stoke -- attribution auto-capture failed to start: "
+                    f"{e!r}"
+                )
+                return
+            # count only traces that actually started: a failing profiler
+            # must neither burn the max_captures budget nor report
+            # phantom captures
+            self.captures += 1
+            self._capturing = True
+            self._capture_stop_at = step + max(1, self.cfg.capture_steps)
+            self._capture_dirs.append(target)
         self.registry.counter(
             "attr/captures_total", help="anomaly-triggered xprof captures"
         ).inc()
@@ -765,12 +777,84 @@ class AttributionMonitor:
     def on_step(self, optimizer_steps: int) -> None:
         """Per-optimizer-step hook (the facade calls this from every step
         boundary): closes an in-flight capture window once it covered
-        ``capture_steps`` steps."""
-        if self._capturing and (
-            self._capture_stop_at is None
-            or optimizer_steps >= self._capture_stop_at
-        ):
+        ``capture_steps`` steps.  A MANUAL capture (ops-plane /profile)
+        is wall-clock-bounded by its own thread, never by step count —
+        the flag keeps this hook's step boundary from truncating it."""
+        with self._capture_lock:
+            if self._manual_capture:
+                return
+            if self._capturing and (
+                self._capture_stop_at is None
+                or optimizer_steps >= self._capture_stop_at
+            ):
+                self._stop_capture()
+
+    def manual_capture(
+        self, seconds: float, reason: str = "manual"
+    ) -> Dict[str, Any]:
+        """One bounded on-demand xprof capture (the ops plane's
+        ``/profile`` executor, ISSUE 20): starts the profiler, sleeps
+        ``seconds`` on the CALLER's thread (the step path keeps running
+        — the capture observes it), then stops.  Shares the
+        ``max_captures`` budget and the in-flight exclusivity with the
+        anomaly-triggered captures, so a scraper can never DoS the run
+        with profiler sessions.  Returns ``{"ok": True, "trace_dir",
+        "seconds", "captures"}`` or ``{"ok": False, "error"}``."""
+        import os
+
+        if self.trace_dir is None:
+            return {
+                "ok": False,
+                "error": "no trace_dir — set ProfilerConfig.trace_dir "
+                "to enable on-demand capture",
+            }
+        safe = "".join(
+            c if (c.isalnum() or c in "-_=.") else "-" for c in reason
+        )[:48]
+        with self._capture_lock:
+            if self._capturing:
+                return {"ok": False, "error": "capture already in flight"}
+            if self.captures >= self.cfg.max_captures:
+                return {
+                    "ok": False,
+                    "error": f"capture budget exhausted "
+                    f"({self.captures}/{self.cfg.max_captures})",
+                }
+            target = os.path.join(
+                self.trace_dir,
+                f"manual-capture-{self.captures + 1}-{safe}",
+            )
+            try:
+                import jax
+
+                jax.profiler.start_trace(target)
+            except Exception as e:
+                return {
+                    "ok": False,
+                    "error": f"profiler failed to start: {e!r}",
+                }
+            # same budget discipline as _start_capture: only a trace
+            # that actually started burns a capture slot
+            self.captures += 1
+            self._capturing = True
+            self._manual_capture = True
+            self._capture_stop_at = None
+            self._capture_dirs.append(target)
+            self.registry.counter(
+                "attr/captures_total",
+                help="anomaly-triggered xprof captures",
+            ).inc()
+        time.sleep(max(0.0, float(seconds)))
+        with self._capture_lock:
             self._stop_capture()
+            self._manual_capture = False
+        return {
+            "ok": True,
+            "trace_dir": target,
+            "seconds": float(seconds),
+            "captures": self.captures,
+            "max_captures": self.cfg.max_captures,
+        }
 
     def _stop_capture(self) -> None:
         try:
@@ -789,5 +873,7 @@ class AttributionMonitor:
         return t
 
     def close(self) -> None:
-        if self._capturing:
-            self._stop_capture()
+        with self._capture_lock:
+            if self._capturing:
+                self._stop_capture()
+                self._manual_capture = False

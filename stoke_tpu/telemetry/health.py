@@ -624,6 +624,11 @@ class HealthMonitor:
         self._exception_dumps = 0
         self._warned: Dict[str, int] = {}
         self._steps_completed = False
+        # the name of the detector that halted the run, set just before
+        # HealthHaltError leaves observe() and never cleared: the ops
+        # plane's /healthz (ISSUE 20) reads it as the load-balancer
+        # drain signal, which must survive the exception unwinding
+        self.halted: Optional[str] = None
         # flat-leaf-index -> path-string table for the param/grad tree
         # (facade-installed; telemetry.numerics.leaf_path_names) — the
         # NonFiniteDetector's leaf-level provenance lookup
@@ -794,6 +799,7 @@ class HealthMonitor:
             elif anomaly.action == "halt":
                 halts.append(anomaly)
         if halts:
+            self.halted = halts[0].detector
             bundle = self.dump(
                 f"halt-{halts[0].detector}",
                 extra=[a.to_dict() for a in halts],

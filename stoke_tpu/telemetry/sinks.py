@@ -135,7 +135,16 @@ def render_prometheus(snapshot: Dict[str, dict], labels: Optional[Dict[str, str]
     if labels:
 
         def esc(v):
-            return str(v).replace("\\", "\\\\").replace('"', '\\"')
+            # exposition-format label escaping: backslash FIRST (or the
+            # escapes it introduces get re-escaped), then quote, then
+            # newline — a raw newline in a label value truncates the
+            # sample line and poisons every scrape of the file
+            return (
+                str(v)
+                .replace("\\", "\\\\")
+                .replace('"', '\\"')
+                .replace("\n", "\\n")
+            )
 
         inner = ",".join(f'{k}="{esc(v)}"' for k, v in sorted(labels.items()))
         label_str = "{" + inner + "}"

@@ -1,0 +1,559 @@
+"""Live ops plane tests (ISSUE 20).
+
+The contract under test: default OFF — without an ``OpsPlaneConfig`` no
+thread starts and no socket binds, records carry zero new JSONL fields,
+and dispatch counts are equal; with the config on, every endpoint
+answers its pinned schema, ``/metrics`` byte-matches the
+``PrometheusSink`` file for the same registry snapshot (single shared
+renderer, hostile label values included), ``/healthz`` flips 200→503 on
+an injected-NaN health halt, multihost ranks bind ``port +
+process_index``, ``/profile`` rides (and exhausts) the attribution
+capture budget, and concurrent scrapers never tear the plane.
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import optax
+import pytest
+
+from stoke_tpu import (
+    HealthConfig,
+    HealthHaltError,
+    OpsPlaneConfig,
+    Stoke,
+    StokeOptimizer,
+    StokeStatus,
+    StokeValidationError,
+    TelemetryConfig,
+    TraceConfig,
+)
+from stoke_tpu.configs import AttributionConfig
+from stoke_tpu.serving.slo import RequestSLO
+from stoke_tpu.telemetry.events import read_step_events
+from stoke_tpu.telemetry.opsplane import STATUSZ_FIELDS, OpsPlane
+from stoke_tpu.telemetry.registry import MetricsRegistry
+from stoke_tpu.telemetry.sinks import PrometheusSink, render_prometheus
+
+pytestmark = [pytest.mark.telemetry, pytest.mark.opsplane]
+
+IN, OUT = 8, 4
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WIRE_MANIFEST = os.path.join(
+    _REPO, "stoke_tpu", "analysis", "manifests", "wire_formats.json"
+)
+
+#: hostile label value exercising every escape the exposition format
+#: defines (backslash, double quote, newline)
+HOSTILE = 'run "A"\\prod\nline2'
+
+
+def _get(url, timeout=10.0):
+    """(status, body bytes) — HTTP errors return their status, not raise."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _get_json(url, timeout=10.0):
+    status, body = _get(url, timeout=timeout)
+    return status, json.loads(body)
+
+
+def _make(tmp_path, tag, *, opsplane=True, health=False, trace=False,
+          prometheus=False):
+    tdir = str(tmp_path / tag)
+    cfgs = [
+        TelemetryConfig(
+            output_dir=tdir, log_every_n_steps=1, prometheus=prometheus,
+            tensorboard=False, sample_device_time=False, track_hbm=False,
+        )
+    ]
+    if opsplane:
+        # port 0 = ephemeral bind: tests never collide on a fixed port
+        cfgs.append(OpsPlaneConfig(port=0))
+    if health:
+        cfgs.append(HealthConfig(nonfinite_action="halt"))
+    if trace:
+        cfgs.append(TraceConfig(output_dir=tdir, export_on_close=False))
+    s = Stoke(
+        model=lambda p, x: x @ p["w"],
+        optimizer=StokeOptimizer(
+            optimizer=optax.sgd, optimizer_kwargs={"learning_rate": 0.05}
+        ),
+        loss=lambda o, y: ((o - y) ** 2).mean(),
+        params={"w": np.ones((IN, OUT), np.float32) * 0.1},
+        batch_size_per_device=4,
+        configs=cfgs,
+        verbose=False,
+    )
+    return s, tdir
+
+
+def _batch(seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(32, IN)).astype(np.float32)
+    y = np.zeros((32, OUT), np.float32)
+    return x, y
+
+
+def _opsplane_threads():
+    return [
+        t for t in threading.enumerate()
+        if t.name.startswith("stoke-opsplane") and t.is_alive()
+    ]
+
+
+# --------------------------------------------------------------------------- #
+# status rules
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize(
+    "cfgs",
+    [
+        # requires a TelemetryConfig
+        [OpsPlaneConfig()],
+        # port out of range
+        [TelemetryConfig(), OpsPlaneConfig(port=-1)],
+        [TelemetryConfig(), OpsPlaneConfig(port=70000)],
+        # unusable bind address
+        [TelemetryConfig(), OpsPlaneConfig(host="")],
+        # capture bounds must bound
+        [TelemetryConfig(), OpsPlaneConfig(profile_max_seconds=0.0)],
+        [TelemetryConfig(), OpsPlaneConfig(profile_default_seconds=0.0)],
+        [
+            TelemetryConfig(),
+            OpsPlaneConfig(
+                profile_default_seconds=5.0, profile_max_seconds=1.0
+            ),
+        ],
+        # a zero row cap would make /requests lie
+        [TelemetryConfig(), OpsPlaneConfig(requests_limit=0)],
+    ],
+)
+def test_status_rejects_invalid(cfgs):
+    with pytest.raises(StokeValidationError):
+        StokeStatus(batch_size_per_device=4, configs=cfgs)
+
+
+def test_status_accepts_valid():
+    st = StokeStatus(
+        batch_size_per_device=4,
+        configs=[TelemetryConfig(), OpsPlaneConfig()],
+    )
+    assert st.opsplane_config is not None
+    assert st.opsplane_config.port == 9200
+
+
+# --------------------------------------------------------------------------- #
+# default-OFF contract
+# --------------------------------------------------------------------------- #
+
+
+def test_default_off_no_thread_no_fields_dispatch_equal(tmp_path, devices):
+    x, y = _batch()
+    s_off, dir_off = _make(tmp_path, "off", opsplane=False)
+    assert s_off.opsplane is None
+    assert _opsplane_threads() == []  # no thread, hence no bound socket
+    for _ in range(2):
+        s_off.train_step(x, y)
+    d_off = s_off.dispatch_count
+    s_off.close_telemetry()
+
+    s_on, dir_on = _make(tmp_path, "on", opsplane=True)
+    assert s_on.opsplane is not None and s_on.opsplane.running
+    assert len(_opsplane_threads()) == 1
+    for _ in range(2):
+        s_on.train_step(x, y)
+    d_on = s_on.dispatch_count
+    port = s_on.opsplane.port
+    s_on.close_telemetry()
+
+    # the plane adds zero dispatches and zero JSONL fields
+    assert d_on == d_off
+    ev_off = read_step_events(os.path.join(dir_off, "steps.jsonl"))
+    ev_on = read_step_events(os.path.join(dir_on, "steps.jsonl"))
+    assert len(ev_off) == len(ev_on) == 2
+    for a, b in zip(ev_off, ev_on):
+        assert set(a) == set(b)
+
+    # teardown is real: the thread is gone and the port refuses
+    assert _opsplane_threads() == []
+    with pytest.raises(urllib.error.URLError):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=2
+        )
+
+
+# --------------------------------------------------------------------------- #
+# /metrics: single renderer, hostile labels, byte-match with the sink
+# --------------------------------------------------------------------------- #
+
+
+def test_render_prometheus_escapes_hostile_labels():
+    reg = MetricsRegistry()
+    reg.counter("ops/hits", help="hi").inc(3)
+    text = render_prometheus(reg.snapshot(), {"run": HOSTILE})
+    # regression (ISSUE 20 satellite): a raw newline in a label value
+    # used to split the sample line and poison the whole scrape
+    assert "\n".join(
+        line for line in text.splitlines() if "line2" in line
+    ).count("\n") == 0
+    sample = [
+        line for line in text.splitlines()
+        if line.startswith("stoke_ops_hits_total{")
+    ]
+    assert len(sample) == 1
+    assert 'run="run \\"A\\"\\\\prod\\nline2"' in sample[0]
+    assert sample[0].endswith(" 3.0")
+
+
+def test_metrics_byte_matches_prometheus_sink(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("serve/tokens_out", help="tokens").inc(17)
+    reg.gauge("serve/kv_occupancy").set(0.5)
+    reg.histogram("serve/ttft_s", help="ttft").observe(0.01)
+    labels = {"rank": "0", "run": HOSTILE, "host": "h", "process_index": "0"}
+    path = str(tmp_path / "metrics.prom")
+    sink = PrometheusSink(path, labels)
+    sink._emit({}, reg.snapshot())
+
+    plane = OpsPlane(
+        OpsPlaneConfig(port=0), registry=reg, labels=labels
+    )
+    plane.start()
+    try:
+        status, body = _get(f"http://127.0.0.1:{plane.port}/metrics")
+    finally:
+        plane.close()
+    assert status == 200
+    with open(path, "rb") as f:
+        assert body == f.read()  # ONE renderer: surfaces can never drift
+
+
+# --------------------------------------------------------------------------- #
+# /statusz: pinned field set
+# --------------------------------------------------------------------------- #
+
+
+def test_statusz_field_pin_and_manifest(tmp_path):
+    with open(_WIRE_MANIFEST) as f:
+        entries = json.load(f)["wire_formats"]
+    pinned = [e for e in entries if e["name"] == "STATUSZ_FIELDS"]
+    assert len(pinned) == 1
+    # the manifest list must be a prefix of the live tuple (append-only)
+    fields = tuple(pinned[0]["fields"])
+    assert STATUSZ_FIELDS[: len(fields)] == fields
+
+    plane = OpsPlane(OpsPlaneConfig(port=0), registry=MetricsRegistry())
+    plane.start()
+    try:
+        status, st = _get_json(f"http://127.0.0.1:{plane.port}/statusz")
+    finally:
+        plane.close()
+    assert status == 200
+    assert tuple(st) == STATUSZ_FIELDS
+    # unattached subsystems render as null, never as missing keys
+    assert st["training"] is None and st["serving"] is None
+    assert st["healthy"] is True and st["halted"] is None
+
+
+# --------------------------------------------------------------------------- #
+# /requests: the in-flight serve table
+# --------------------------------------------------------------------------- #
+
+
+def _scheduler(max_seqs=2, queue_n=1):
+    from stoke_tpu.serving.kv_cache import BlockAllocator
+    from stoke_tpu.serving.scheduler import Scheduler
+
+    alloc = BlockAllocator(16, 8)
+    sched = Scheduler(
+        max_seqs, alloc, 4, max_seq_len=64, default_max_new_tokens=8
+    )
+    for i in range(queue_n):
+        sched.submit(
+            np.arange(4) + 1,
+            slo=RequestSLO(priority="interactive", ttft_target_s=30.0),
+        )
+    return sched
+
+
+def test_requests_table_states_and_headroom():
+    sched = _scheduler(queue_n=2)
+    # hand-place one queued request into a decoding slot (the table reads
+    # scheduler state; admission mechanics are the scheduler tests' job)
+    req = sched.queue.popleft()
+    req.tokens.extend([5, 6, 7])
+    sched.slots[0].request = req
+    sched.slots[0].blocks = [1, 2]
+    sched.slots[0].prefill_pos = None
+    engine = SimpleNamespace(
+        scheduler=sched,
+        metrics=SimpleNamespace(registry=MetricsRegistry()),
+        summary=lambda: {"requests": 2},
+    )
+    plane = OpsPlane(OpsPlaneConfig(port=0), registry=MetricsRegistry())
+    plane.attach_engine(engine)
+    plane.start()
+    try:
+        base = f"http://127.0.0.1:{plane.port}"
+        status, table = _get_json(f"{base}/requests")
+        _, st = _get_json(f"{base}/statusz")
+    finally:
+        plane.close()
+    assert status == 200 and table["truncated"] is False
+    rows = {r["rid"]: r for r in table["requests"]}
+    assert len(rows) == 2
+    queued = [r for r in rows.values() if r["state"] == "queued"]
+    decoding = [r for r in rows.values() if r["state"] == "decoding"]
+    assert len(queued) == 1 and len(decoding) == 1
+    assert queued[0]["kv_blocks"] == 0 and queued[0]["tokens_out"] == 0
+    assert decoding[0]["kv_blocks"] == 2 and decoding[0]["tokens_out"] == 3
+    for r in rows.values():
+        assert r["priority"] == "interactive"
+        # TTFT deadline headroom: target minus age, still generous here
+        assert 0 < r["slo_headroom_s"] <= 30.0
+        assert r["age_s"] >= 0
+    # the engine summary rides /statusz as the serving block
+    assert st["serving"] == {"requests": 2}
+
+
+def test_requests_table_truncation():
+    sched = _scheduler(queue_n=5)
+    engine = SimpleNamespace(
+        scheduler=sched,
+        metrics=SimpleNamespace(registry=MetricsRegistry()),
+        summary=lambda: {},
+    )
+    plane = OpsPlane(
+        OpsPlaneConfig(port=0, requests_limit=3),
+        registry=MetricsRegistry(),
+    )
+    plane.attach_engine(engine)
+    plane.start()
+    try:
+        _, table = _get_json(
+            f"http://127.0.0.1:{plane.port}/requests"
+        )
+    finally:
+        plane.close()
+    assert table["truncated"] is True
+    assert len(table["requests"]) == 3
+
+
+# --------------------------------------------------------------------------- #
+# rank binding
+# --------------------------------------------------------------------------- #
+
+
+def test_rank_offsets_base_port():
+    cfg = OpsPlaneConfig(port=9321)
+    assert OpsPlane(cfg, rank=0).port == 9321
+    assert OpsPlane(cfg, rank=3).port == 9324
+    # ephemeral base stays ephemeral — an offset of 0 is meaningless
+    assert OpsPlane(OpsPlaneConfig(port=0), rank=3).port == 0
+
+
+def test_two_ranks_bind_adjacent_ports():
+    import socket
+
+    for _ in range(5):  # the free base port can race; retry fresh ones
+        with socket.socket() as probe:
+            probe.bind(("127.0.0.1", 0))
+            base = probe.getsockname()[1]
+        cfg = OpsPlaneConfig(port=base)
+        r0 = OpsPlane(cfg, registry=MetricsRegistry(), rank=0)
+        r1 = OpsPlane(cfg, registry=MetricsRegistry(), rank=1)
+        try:
+            r0.start()
+            r1.start()
+        except OSError:
+            r0.close()
+            r1.close()
+            continue
+        try:
+            assert (r0.port, r1.port) == (base, base + 1)
+            s0, z0 = _get_json(f"http://127.0.0.1:{base}/statusz")
+            s1, z1 = _get_json(f"http://127.0.0.1:{base + 1}/statusz")
+            assert (s0, s1) == (200, 200)
+            assert (z0["rank"], z1["rank"]) == (0, 1)
+        finally:
+            r0.close()
+            r1.close()
+        return
+    pytest.skip("no stable adjacent port pair after 5 attempts")
+
+
+# --------------------------------------------------------------------------- #
+# /profile: bounded capture riding the attribution budget
+# --------------------------------------------------------------------------- #
+
+
+def test_profile_budget_and_clamp(tmp_path):
+    from stoke_tpu.telemetry.attribution import AttributionMonitor
+
+    mon = AttributionMonitor(
+        AttributionConfig(peak_tflops=1.0, max_captures=1),
+        MetricsRegistry(),
+        trace_dir=str(tmp_path / "xprof"),
+    )
+    plane = OpsPlane(
+        OpsPlaneConfig(port=0, profile_max_seconds=0.2),
+        registry=MetricsRegistry(),
+    )
+    plane.attach_attribution(mon)
+    plane.start()
+    try:
+        base = f"http://127.0.0.1:{plane.port}"
+        status, body = _get_json(f"{base}/profile?seconds=60")
+        assert status == 200 and body["ok"] is True
+        # a scraper asking for a minute got the configured ceiling
+        assert body["seconds"] == pytest.approx(0.2)
+        assert body["captures"] == 1
+        assert os.path.isdir(body["trace_dir"])
+        # budget exhausted: the plane refuses, the run keeps its profiler
+        status, body = _get_json(f"{base}/profile?seconds=0.05")
+        assert status == 429 and "budget" in body["error"]
+        assert mon.captures == 1
+        # malformed duration is a client error, not a capture
+        status, _ = _get_json(f"{base}/profile?seconds=banana")
+        assert status == 400
+        status, _ = _get_json(f"{base}/profile?seconds=-1")
+        assert status == 400
+    finally:
+        plane.close()
+        mon.close()
+
+
+def test_profile_without_attribution_is_404():
+    plane = OpsPlane(OpsPlaneConfig(port=0), registry=MetricsRegistry())
+    plane.start()
+    try:
+        status, body = _get_json(
+            f"http://127.0.0.1:{plane.port}/profile"
+        )
+    finally:
+        plane.close()
+    assert status == 404 and body["ok"] is False
+
+
+# --------------------------------------------------------------------------- #
+# concurrency + read-only discipline
+# --------------------------------------------------------------------------- #
+
+
+def test_concurrent_scrapes_do_not_tear(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("ops/spin").inc()
+    sched = _scheduler(queue_n=2)
+    engine = SimpleNamespace(
+        scheduler=sched,
+        metrics=SimpleNamespace(registry=reg),
+        summary=lambda: {"requests": len(sched.queue)},
+    )
+    plane = OpsPlane(OpsPlaneConfig(port=0), registry=reg)
+    plane.attach_engine(engine)
+    plane.start()
+    base = f"http://127.0.0.1:{plane.port}"
+    stop = threading.Event()
+
+    def churn():
+        # mutate the exact state the scrapers read
+        while not stop.is_set():
+            reg.counter("ops/spin").inc()
+            reg.gauge("ops/gauge").set(1.0)
+
+    churner = threading.Thread(target=churn, daemon=True)
+    churner.start()
+    failures = []
+
+    def scrape():
+        for _ in range(10):
+            for ep in ("/metrics", "/statusz", "/requests", "/healthz"):
+                status, _ = _get(base + ep)
+                if status != 200:
+                    failures.append((ep, status))
+
+    threads = [threading.Thread(target=scrape) for _ in range(6)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        stop.set()
+        churner.join(timeout=5)
+        plane.close()
+    assert failures == []
+
+
+def test_plane_is_read_only():
+    plane = OpsPlane(OpsPlaneConfig(port=0), registry=MetricsRegistry())
+    plane.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{plane.port}/statusz",
+            data=b"{}",
+            method="POST",
+        )
+        try:
+            urllib.request.urlopen(req, timeout=5)
+            status = 200
+        except urllib.error.HTTPError as e:
+            status = e.code
+    finally:
+        plane.close()
+    assert status == 405
+
+
+# --------------------------------------------------------------------------- #
+# facade integration: /healthz flip, /trace, teardown
+# --------------------------------------------------------------------------- #
+
+
+def test_facade_healthz_flip_and_trace(tmp_path, devices):
+    s, _ = _make(tmp_path, "flip", health=True, trace=True)
+    plane = s.opsplane
+    base = f"http://127.0.0.1:{plane.port}"
+    x, y = _batch()
+    s.train_step(x, y)
+    status, body = _get_json(f"{base}/healthz")
+    assert status == 200 and body["ok"] is True
+
+    # the span ring is live on /trace (metadata + X duration events)
+    status, events = _get_json(f"{base}/trace")
+    assert status == 200 and isinstance(events, list) and events
+    assert {e["ph"] for e in events} >= {"M", "X"}
+    assert any(
+        e["ph"] == "X" and e["name"] == "stoke/dispatch" for e in events
+    )
+
+    # the injected-NaN halt is the load-balancer drain signal
+    xn = x.copy()
+    xn[:, 3] = np.nan
+    with pytest.raises(HealthHaltError):
+        s.train_step(xn, y)
+    status, body = _get_json(f"{base}/healthz")
+    assert status == 503
+    assert body["halted"] == "nonfinite_grads" and body["anomalies"] >= 1
+    status, st = _get_json(f"{base}/statusz")
+    assert status == 200
+    assert st["healthy"] is False and st["halted"] == "nonfinite_grads"
+    # trace summary rides the training block once a tracer exists
+    assert st["training"]["trace"]["spans"] >= 1
+
+    port = plane.port
+    s.close_telemetry()
+    assert not plane.running
+    with pytest.raises(urllib.error.URLError):
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=2)
